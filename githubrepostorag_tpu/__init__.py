@@ -1,0 +1,25 @@
+"""githubrepostorag_tpu — a TPU-native code-repository RAG framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+jasonbuchanan145/GithubReposToRag: hierarchical five-level vector ingest
+(catalog/repo/module/file/chunk), agentic plan->retrieve->judge->rewrite->
+synthesize query answering, job queue + SSE progress streaming, and an
+in-tree TPU serving stack (Qwen2 decoder with paged attention and
+continuous batching; BERT-class embedding encoder) in place of the
+reference's out-of-tree vLLM/CUDA and CPU-torch paths.
+
+Layers (bottom-up), mirroring SURVEY.md §1:
+  store/    L0  vector storage (in-memory, native C++, Cassandra)
+  models/   L1  model definitions (Qwen2 decoder, BERT encoder)
+  ops/      L1  TPU ops (Pallas paged attention, RoPE, RMSNorm, sampling)
+  serving/  L1  engine: paged KV cache, continuous batching, OpenAI API
+  parallel/ --  mesh / sharding / collectives (TP, DP, SP ring attention)
+  retrieval/L2  scoped retrievers with metadata-edge graph expansion
+  agent/    L3  the agentic query loop
+  ingest/   L3' the index-building pipeline
+  events/   L4  job queue + progress bus + cancel flags
+  api/      L5  REST control plane + SSE + health + metrics + static UI
+  training/ --  sharded fine-tuning step (mesh dp/tp/sp)
+"""
+
+__version__ = "0.1.0"

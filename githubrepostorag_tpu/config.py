@@ -1,0 +1,181 @@
+"""Unified typed configuration for every service in the framework.
+
+The reference scatters configuration across three places with duplicated and
+conflicting definitions (rag_shared/config.py defines MAX_RAG_ATTEMPTS three
+times and REDIS_URL twice with different defaults; ingest/src/app/config.py
+has its own frozen dataclass; helm injects env vars per pod).  This module
+consolidates everything into one frozen dataclass built from the *same
+environment variable names* so existing deployments carry over unchanged.
+
+Reference surface being unified (file:line in /root/reference):
+  - rag_shared/config.py:1-47       (api + worker constants)
+  - ingest/src/app/config.py:13-47  (SettingsConfig)
+  - ingest/src/app/config.py:50-84  (EXTENSION_TO_LANGUAGE)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return str(val).strip().lower() in {"1", "true", "t", "yes", "y", "on"}
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclass(frozen=True)
+class Settings:
+    """All knobs, one place.  Field defaults match the reference's env names
+    and values exactly (last-definition-wins where the reference conflicted)."""
+
+    # --- Logging ---
+    log_level: str = field(default_factory=lambda: os.getenv("LOG_LEVEL", "INFO"))
+
+    # --- Event bus / job queue (Redis-compatible; in-memory fake for tests) ---
+    redis_url: str = field(default_factory=lambda: os.getenv("REDIS_URL", "redis://redis-master:6379/0"))
+    sse_ping_seconds: int = field(default_factory=lambda: _env_int("SSE_PING_SECONDS", 15))
+
+    # --- Agent loop budget ---
+    max_rag_attempts: int = field(default_factory=lambda: _env_int("MAX_RAG_ATTEMPTS", 3))
+    min_source_nodes: int = field(default_factory=lambda: _env_int("MIN_SOURCE_NODES", 1))
+    router_top_k: int = field(default_factory=lambda: _env_int("ROUTER_TOP_K", 5))
+
+    # --- Vector store (Cassandra-compatible; in-memory / native store for local) ---
+    cassandra_host: str = field(default_factory=lambda: os.getenv("CASSANDRA_HOST", "localhost"))
+    cassandra_port: int = field(default_factory=lambda: _env_int("CASSANDRA_PORT", 9042))
+    cassandra_username: str = field(default_factory=lambda: os.getenv("CASSANDRA_USERNAME", "cassandra"))
+    cassandra_password: str = field(default_factory=lambda: os.getenv("CASSANDRA_PASSWORD", "cassandra"))
+    cassandra_keyspace: str = field(default_factory=lambda: os.getenv("CASSANDRA_KEYSPACE", "vector_store"))
+    store_backend: str = field(default_factory=lambda: os.getenv("STORE_BACKEND", "memory"))  # memory|native|cassandra
+    store_path: str = field(default_factory=lambda: os.getenv("STORE_PATH", ""))  # persistence dir for memory/native
+
+    # Five-level hierarchy tables (cassandra-initdb-configmap.yaml:14-102)
+    embeddings_table_catalog: str = field(default_factory=lambda: os.getenv("EMBEDDINGS_TABLE_CATALOG", "embeddings_catalog"))
+    embeddings_table_repo: str = field(default_factory=lambda: os.getenv("EMBEDDINGS_TABLE_REPO", "embeddings_repo"))
+    embeddings_table_module: str = field(default_factory=lambda: os.getenv("EMBEDDINGS_TABLE_MODULE", "embeddings_module"))
+    embeddings_table_file: str = field(default_factory=lambda: os.getenv("EMBEDDINGS_TABLE_FILE", "embeddings_file"))
+    embeddings_table_chunk: str = field(
+        default_factory=lambda: os.getenv("EMBEDDINGS_TABLE_CHUNK", os.getenv("EMBEDDINGS_TABLE", "embeddings"))
+    )
+
+    # --- Embeddings ---
+    embed_model: str = field(default_factory=lambda: os.getenv("EMBED_MODEL", "intfloat/e5-small-v2"))
+    embed_dim: int = field(default_factory=lambda: _env_int("EMBED_DIM", 384))
+
+    # --- LLM serving (in-tree TPU engine; endpoint kept for split deploys) ---
+    qwen_endpoint: str = field(default_factory=lambda: os.getenv("QWEN_ENDPOINT", "http://qwen:8000"))
+    qwen_model: str = field(default_factory=lambda: os.getenv("QWEN_MODEL", "Qwen/Qwen2.5-3B-Instruct"))
+    qwen_max_output: int = field(default_factory=lambda: _env_int("QWEN_MAX_OUTPUT", 4096))
+    qwen_temperature: float = field(default_factory=lambda: _env_float("QWEN_TEMPERATURE", 0.7))
+    qwen_top_p: float = field(default_factory=lambda: _env_float("QWEN_TOP_P", 0.9))
+    context_window: int = field(default_factory=lambda: _env_int("CONTEXT_WINDOW", 11712))
+    llm_backend: str = field(default_factory=lambda: os.getenv("LLM_BACKEND", "inprocess"))  # inprocess|http|fake
+    model_weights_path: str = field(default_factory=lambda: os.getenv("MODEL_WEIGHTS_PATH", ""))
+
+    # --- Worker ---
+    default_namespace: str = field(default_factory=lambda: os.getenv("DEFAULT_NAMESPACE", "default"))
+    metrics_port: int = field(default_factory=lambda: _env_int("METRICS_PORT", 9000))
+    worker_max_jobs: int = field(default_factory=lambda: _env_int("WORKER_MAX_JOBS", 10))
+    job_timeout_seconds: int = field(default_factory=lambda: _env_int("JOB_TIMEOUT_SECONDS", 300))
+    keep_result_seconds: int = field(default_factory=lambda: _env_int("KEEP_RESULT_SECONDS", 3600))
+
+    # --- Ingest ---
+    github_token: str = field(default_factory=lambda: os.getenv("GITHUB_TOKEN", ""))
+    github_user: str = field(default_factory=lambda: os.getenv("GITHUB_USER", ""))
+    data_dir: str = field(default_factory=lambda: os.getenv("DATA_DIR", ""))
+    default_branch: str = field(default_factory=lambda: os.getenv("DEFAULT_BRANCH", "main"))
+    default_collection: str = field(default_factory=lambda: os.getenv("DEFAULT_COLLECTION", "misc"))
+    dev_force_standalone: bool = field(default_factory=lambda: _env_bool("DEV_MODE", False))
+    pushgateway_url: str = field(default_factory=lambda: os.getenv("PUSHGATEWAY_URL", ""))
+
+    # --- TPU / parallelism ---
+    mesh_shape: str = field(default_factory=lambda: os.getenv("MESH_SHAPE", ""))  # e.g. "dp:2,tp:4"
+    dtype: str = field(default_factory=lambda: os.getenv("MODEL_DTYPE", "bfloat16"))
+    kv_page_size: int = field(default_factory=lambda: _env_int("KV_PAGE_SIZE", 16))
+    kv_num_pages: int = field(default_factory=lambda: _env_int("KV_NUM_PAGES", 2048))
+    max_num_seqs: int = field(default_factory=lambda: _env_int("MAX_NUM_SEQS", 64))
+    prefill_chunk: int = field(default_factory=lambda: _env_int("PREFILL_CHUNK", 512))
+
+    @property
+    def scope_tables(self) -> dict[str, str]:
+        """scope name -> table name, the 5-level hierarchy."""
+        return {
+            "catalog": self.embeddings_table_catalog,
+            "repo": self.embeddings_table_repo,
+            "module": self.embeddings_table_module,
+            "file": self.embeddings_table_file,
+            "chunk": self.embeddings_table_chunk,
+        }
+
+
+# Map file extensions to language names for the AST-aware chunker
+# (ingest/src/app/config.py:50-84 in the reference).
+EXTENSION_TO_LANGUAGE: dict[str, str] = {
+    ".js": "javascript",
+    ".jsx": "javascript",
+    ".ts": "typescript",
+    ".tsx": "typescript",
+    ".py": "python",
+    ".java": "java",
+    ".cpp": "cpp",
+    ".cc": "cpp",
+    ".cxx": "cpp",
+    ".c": "c",
+    ".h": "c",
+    ".cs": "c_sharp",
+    ".php": "php",
+    ".rb": "ruby",
+    ".go": "go",
+    ".rs": "rust",
+    ".swift": "swift",
+    ".kt": "kotlin",
+    ".scala": "scala",
+    ".sh": "bash",
+    ".bash": "bash",
+    ".sql": "sql",
+    ".html": "html",
+    ".htm": "html",
+    ".css": "css",
+    ".json": "json",
+    ".xml": "xml",
+    ".yaml": "yaml",
+    ".yml": "yaml",
+    ".toml": "toml",
+    ".md": "markdown",
+    ".dockerfile": "dockerfile",
+}
+
+
+_settings: Settings | None = None
+
+
+def get_settings() -> Settings:
+    """Process-wide settings singleton (env read once, first use)."""
+    global _settings
+    if _settings is None:
+        _settings = Settings()
+    return _settings
+
+
+def reload_settings() -> Settings:
+    """Re-read the environment (used by tests that monkeypatch env vars)."""
+    global _settings
+    _settings = Settings()
+    return _settings

@@ -1,0 +1,45 @@
+"""L4: job queue + progress event bus + cancel flags.
+
+Protocol-compatible with the reference's Redis pub/sub bus
+(rag_shared/bus.py:8-40): channel ``job:{id}:events`` carries JSON frames
+``{"event": <name>, "data": {...}}`` rendered to SSE as ``data: <json>\n\n``
+with ``: ping\n\n`` keepalives; cancellation is a flag key ``job:{id}:cancel``
+with a 3600 s TTL.
+
+Implementations:
+  - ``MemoryBus`` / ``MemoryCancelFlags`` / ``MemoryJobQueue`` — in-process,
+    for tests and single-pod deployments (no Redis needed at all).
+  - ``githubrepostorag_tpu.events.redis`` — the same wire behavior against a
+    real Redis via the in-tree minimal RESP client (no third-party redis
+    package required); imported lazily so the package works without it.
+"""
+
+from githubrepostorag_tpu.events.base import (
+    CancelFlags,
+    EnqueuedJob,
+    JobQueue,
+    ProgressBus,
+    sse_frame,
+    PING_FRAME,
+)
+from githubrepostorag_tpu.events.memory import (
+    MemoryBus,
+    MemoryCancelFlags,
+    MemoryJobQueue,
+    get_memory_hub,
+    reset_memory_hub,
+)
+
+__all__ = [
+    "ProgressBus",
+    "CancelFlags",
+    "JobQueue",
+    "EnqueuedJob",
+    "sse_frame",
+    "PING_FRAME",
+    "MemoryBus",
+    "MemoryCancelFlags",
+    "MemoryJobQueue",
+    "get_memory_hub",
+    "reset_memory_hub",
+]

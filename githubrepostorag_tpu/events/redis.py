@@ -1,0 +1,116 @@
+"""Redis-backed bus / cancel flags / job queue over the in-tree RESP client.
+
+Wire-behavior parity with the reference (rag_shared/bus.py): events published
+on ``job:{id}:events``, cancel flag ``job:{id}:cancel`` SET EX 3600, SSE
+framing with ~1 Hz pings.  The job queue uses LPUSH/BRPOP on a list (the
+at-most-once dequeue semantics the reference gets from ARQ) with results in
+``job:{id}:result`` SET EX keep_result.
+
+These classes are only constructed when a REDIS_URL deployment is selected;
+tests and single-pod deploys use the memory implementations.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, AsyncIterator
+
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.events.base import (
+    CANCEL_TTL_SECONDS,
+    CancelFlags,
+    EnqueuedJob,
+    JobQueue,
+    PING_FRAME,
+    ProgressBus,
+    cancel_key_for,
+    channel_for,
+    encode_event,
+    sse_frame,
+)
+from githubrepostorag_tpu.events.resp import RespConnection
+
+_QUEUE_KEY = "rag:jobs:queue"
+
+
+class RedisBus(ProgressBus):
+    def __init__(self, url: str | None = None, ping_interval: float = 1.0) -> None:
+        self._url = url or get_settings().redis_url
+        self._cmd = RespConnection(self._url)
+        self._ping_interval = ping_interval
+
+    async def emit(self, job_id: str, event: str, data: dict[str, Any]) -> None:
+        await self._cmd.command("PUBLISH", channel_for(job_id), encode_event(event, data))
+
+    async def stream(self, job_id: str) -> AsyncIterator[str]:
+        import asyncio
+
+        conn = RespConnection(self._url)
+        await conn.connect()
+        await conn.send("SUBSCRIBE", channel_for(job_id))
+        await conn.read_reply()  # subscribe ack
+        try:
+            while True:
+                try:
+                    reply = await asyncio.wait_for(conn.read_reply(), timeout=self._ping_interval)
+                except asyncio.TimeoutError:
+                    yield PING_FRAME
+                    continue
+                if isinstance(reply, list) and len(reply) == 3 and reply[0] == "message":
+                    yield sse_frame(reply[2])
+        finally:
+            await conn.close()
+
+    async def close(self) -> None:
+        await self._cmd.close()
+
+
+class RedisCancelFlags(CancelFlags):
+    def __init__(self, url: str | None = None) -> None:
+        self._conn = RespConnection(url or get_settings().redis_url)
+
+    async def cancel(self, job_id: str) -> None:
+        await self._conn.command("SET", cancel_key_for(job_id), "1", "EX", CANCEL_TTL_SECONDS)
+
+    async def is_cancelled(self, job_id: str) -> bool:
+        return await self._conn.command("GET", cancel_key_for(job_id)) is not None
+
+
+class RedisJobQueue(JobQueue):
+    def __init__(self, url: str | None = None) -> None:
+        self._url = url or get_settings().redis_url
+        self._cmd = RespConnection(self._url)
+        self._pop = RespConnection(self._url)  # BRPOP blocks; keep it separate
+        self._keep_result = get_settings().keep_result_seconds
+
+    async def enqueue_job(self, function: str, *args: Any, _job_id: str | None = None, **kwargs: Any) -> EnqueuedJob:
+        import uuid
+
+        job = EnqueuedJob(job_id=_job_id or uuid.uuid4().hex, function=function, args=args, kwargs=kwargs)
+        payload = json.dumps(
+            {"job_id": job.job_id, "function": job.function, "args": list(job.args), "kwargs": job.kwargs}
+        )
+        await self._cmd.command("LPUSH", _QUEUE_KEY, payload)
+        return job
+
+    async def dequeue(self) -> EnqueuedJob:
+        while True:
+            reply = await self._pop.command("BRPOP", _QUEUE_KEY, 1)
+            if reply is None:
+                continue
+            raw = json.loads(reply[1])
+            return EnqueuedJob(
+                job_id=raw["job_id"],
+                function=raw["function"],
+                args=tuple(raw.get("args", ())),
+                kwargs=raw.get("kwargs", {}),
+            )
+
+    async def set_result(self, job_id: str, result: Any) -> None:
+        await self._cmd.command(
+            "SET", f"job:{job_id}:result", json.dumps(result, ensure_ascii=False), "EX", self._keep_result
+        )
+
+    async def get_result(self, job_id: str) -> Any:
+        raw = await self._cmd.command("GET", f"job:{job_id}:result")
+        return json.loads(raw) if raw is not None else None

@@ -1,0 +1,30 @@
+"""L0: vector storage.
+
+Schema-compatible with the reference's five Cassandra tables
+(helm/templates/cassandra-initdb-configmap.yaml:7-102): each row is
+``(row_id TEXT, body_blob TEXT, vector VECTOR<FLOAT, EMBED_DIM>,
+metadata_s MAP<TEXT,TEXT>)`` with an ANN index (cosine) on ``vector`` and an
+entries index on ``metadata_s`` for equality filtering.
+
+Implementations:
+  - ``MemoryVectorStore`` — brute-force cosine over numpy, exact-match
+    metadata filters, optional JSON persistence.  The test backbone and the
+    local/dev backend.
+  - ``NativeVectorStore`` — same semantics with the scoring loop in C++
+    (SIMD) behind ctypes, for large local indexes.
+  - ``CassandraVectorStore`` — real Cassandra 5 SAI (gated on the
+    cassandra-driver package being installed).
+"""
+
+from githubrepostorag_tpu.store.base import Doc, SearchHit, VectorStore
+from githubrepostorag_tpu.store.memory import MemoryVectorStore
+from githubrepostorag_tpu.store.factory import get_store, reset_store
+
+__all__ = [
+    "Doc",
+    "SearchHit",
+    "VectorStore",
+    "MemoryVectorStore",
+    "get_store",
+    "reset_store",
+]

@@ -1,0 +1,83 @@
+"""Vector store interface: upsert / ANN search / metadata lookup.
+
+The row shape mirrors the reference's Cassandra schema
+(cassandra-initdb-configmap.yaml:14-29): ``row_id``, ``body_blob``,
+``vector``, ``metadata_s MAP<TEXT,TEXT>``.  Metadata values are *strings
+only* — the ingest sanitizer (vector_write_service.py:44-98 in the
+reference) flattens everything to text before writing, and retrieval-side
+edge traversal joins on string equality.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+
+@dataclass
+class Doc:
+    """One stored row.  ``vector`` may be None before embedding."""
+
+    doc_id: str
+    text: str
+    metadata: dict[str, str] = field(default_factory=dict)
+    vector: np.ndarray | None = None
+
+
+@dataclass
+class SearchHit:
+    doc: Doc
+    score: float  # cosine similarity in [-1, 1]
+
+
+def _match(metadata: Mapping[str, str], flt: Mapping[str, str] | None) -> bool:
+    if not flt:
+        return True
+    return all(metadata.get(k) == v for k, v in flt.items())
+
+
+class VectorStore(abc.ABC):
+    """Five logical tables (catalog/repo/module/file/chunk), ANN + filters."""
+
+    @abc.abstractmethod
+    def upsert(self, table: str, docs: Sequence[Doc]) -> int:
+        """Idempotent write keyed by doc_id.  Returns rows written."""
+
+    @abc.abstractmethod
+    def search(
+        self,
+        table: str,
+        query_vector: np.ndarray,
+        k: int,
+        filter: Mapping[str, str] | None = None,
+    ) -> list[SearchHit]:
+        """Cosine ANN with optional exact-match metadata filter."""
+
+    @abc.abstractmethod
+    def find_by_metadata(
+        self,
+        table: str,
+        filter: Mapping[str, str],
+        limit: int = 100,
+    ) -> list[Doc]:
+        """Equality lookup on metadata entries (the graph-edge traversal
+        primitive: SAI entries(metadata_s) index in the reference)."""
+
+    @abc.abstractmethod
+    def get(self, table: str, doc_id: str) -> Doc | None: ...
+
+    @abc.abstractmethod
+    def count(self, table: str) -> int: ...
+
+    @abc.abstractmethod
+    def delete(self, table: str, doc_ids: Iterable[str]) -> int: ...
+
+    @abc.abstractmethod
+    def tables(self) -> list[str]: ...
+
+    def health(self) -> dict:
+        """Liveness + per-table row counts (feeds the deep /health probe)."""
+        return {"status": "UP", "tables": {t: self.count(t) for t in self.tables()}}

@@ -1,0 +1,186 @@
+"""Cassandra 5 vector store backend (SAI ANN, cosine).
+
+Behavioral equivalent of the reference's storage path
+(ingest/src/app/services/cassandra_service.py:93-197 + the initdb CQL in
+helm/templates/cassandra-initdb-configmap.yaml): keyspace ensure with
+SimpleStrategy RF=1, one table per hierarchy scope with a cosine SAI index on
+``vector`` and an entries index on ``metadata_s``, idempotent upserts keyed by
+``row_id``.
+
+Gated on the ``cassandra-driver`` package: importing this module without it
+raises a clear error, and the factory only reaches here when
+STORE_BACKEND=cassandra.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+from githubrepostorag_tpu.store.base import Doc, SearchHit, VectorStore
+
+try:  # pragma: no cover - exercised only with live infra
+    from cassandra.auth import PlainTextAuthProvider
+    from cassandra.cluster import Cluster
+
+    _HAVE_DRIVER = True
+except ImportError:  # pragma: no cover
+    _HAVE_DRIVER = False
+
+
+_DDL_KEYSPACE = (
+    "CREATE KEYSPACE IF NOT EXISTS {ks} WITH REPLICATION = "
+    "{{'class':'SimpleStrategy','replication_factor':1}}"
+)
+_DDL_TABLE = (
+    "CREATE TABLE IF NOT EXISTS {ks}.{table} ("
+    " row_id TEXT PRIMARY KEY,"
+    " attributes_blob TEXT,"
+    " body_blob TEXT,"
+    " vector VECTOR<FLOAT, {dim}>,"
+    " metadata_s MAP<TEXT, TEXT>)"
+)
+_DDL_VIDX = (
+    "CREATE CUSTOM INDEX IF NOT EXISTS idx_vector_{table} ON {ks}.{table} (vector)"
+    " USING 'org.apache.cassandra.index.sai.StorageAttachedIndex'"
+    " WITH OPTIONS = {{'similarity_function':'cosine'}}"
+)
+_DDL_MIDX = (
+    "CREATE CUSTOM INDEX IF NOT EXISTS eidx_metadata_s_{table} ON {ks}.{table}"
+    " (entries(metadata_s))"
+    " USING 'org.apache.cassandra.index.sai.StorageAttachedIndex'"
+)
+
+
+class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only
+    def __init__(
+        self,
+        hosts: list[str],
+        port: int = 9042,
+        username: str = "cassandra",
+        password: str = "cassandra",
+        keyspace: str = "vector_store",
+        embed_dim: int = 384,
+    ) -> None:
+        if not _HAVE_DRIVER:
+            raise ImportError(
+                "STORE_BACKEND=cassandra requires the cassandra-driver package; "
+                "use STORE_BACKEND=memory or STORE_BACKEND=native otherwise"
+            )
+        auth = PlainTextAuthProvider(username=username, password=password)
+        self._cluster = Cluster(contact_points=hosts, port=port, auth_provider=auth)
+        self._session = self._cluster.connect()
+        self._ks = keyspace
+        self._dim = embed_dim
+        self._known_tables: set[str] = set()
+        self._insert_stmts: dict[str, object] = {}
+        self._session.execute(_DDL_KEYSPACE.format(ks=keyspace))
+
+    def _ensure_table(self, table: str) -> None:
+        if table in self._known_tables:
+            return
+        self._session.execute(_DDL_TABLE.format(ks=self._ks, table=table, dim=self._dim))
+        self._session.execute(_DDL_VIDX.format(ks=self._ks, table=table))
+        self._session.execute(_DDL_MIDX.format(ks=self._ks, table=table))
+        self._known_tables.add(table)
+
+    def upsert(self, table: str, docs: Sequence[Doc]) -> int:
+        self._ensure_table(table)
+        stmt = self._insert_stmts.get(table)
+        if stmt is None:
+            stmt = self._session.prepare(
+                f"INSERT INTO {self._ks}.{table} (row_id, body_blob, vector, metadata_s) VALUES (?, ?, ?, ?)"
+            )
+            self._insert_stmts[table] = stmt
+        for doc in docs:
+            vec = [float(x) for x in doc.vector] if doc.vector is not None else None
+            self._session.execute(stmt, (doc.doc_id, doc.text, vec, dict(doc.metadata)))
+        return len(docs)
+
+    def search(
+        self,
+        table: str,
+        query_vector: np.ndarray,
+        k: int,
+        filter: Mapping[str, str] | None = None,
+    ) -> list[SearchHit]:
+        self._ensure_table(table)
+        where = ""
+        params: list = [[float(x) for x in np.asarray(query_vector).reshape(-1)]]
+        if filter:
+            clauses = []
+            for key, val in filter.items():
+                clauses.append("metadata_s[%s] = %s")
+                params.extend([key, val])
+            where = " WHERE " + " AND ".join(clauses)
+        params.append(int(k))
+        cql = (
+            f"SELECT row_id, body_blob, metadata_s, similarity_cosine(vector, %s) AS score "
+            f"FROM {self._ks}.{table}{where} ORDER BY vector ANN OF %s LIMIT %s"
+        )
+        # ANN OF needs the vector twice (score projection + ordering)
+        params.insert(-1, params[0])
+        rows = self._session.execute(cql, params)
+        return [
+            SearchHit(Doc(r.row_id, r.body_blob or "", dict(r.metadata_s or {})), float(r.score))
+            for r in rows
+        ]
+
+    def find_by_metadata(self, table: str, filter: Mapping[str, str], limit: int = 100) -> list[Doc]:
+        self._ensure_table(table)
+        clauses, params = [], []
+        for key, val in filter.items():
+            clauses.append("metadata_s[%s] = %s")
+            params.extend([key, val])
+        params.append(int(limit))
+        cql = (
+            f"SELECT row_id, body_blob, metadata_s FROM {self._ks}.{table} "
+            f"WHERE {' AND '.join(clauses)} LIMIT %s"
+        )
+        rows = self._session.execute(cql, params)
+        return [Doc(r.row_id, r.body_blob or "", dict(r.metadata_s or {})) for r in rows]
+
+    def get(self, table: str, doc_id: str) -> Doc | None:
+        self._ensure_table(table)
+        rows = self._session.execute(
+            f"SELECT row_id, body_blob, metadata_s FROM {self._ks}.{table} WHERE row_id = %s",
+            (doc_id,),
+        )
+        row = rows.one()
+        return Doc(row.row_id, row.body_blob or "", dict(row.metadata_s or {})) if row else None
+
+    def count(self, table: str) -> int:
+        self._ensure_table(table)
+        row = self._session.execute(f"SELECT COUNT(*) AS n FROM {self._ks}.{table}").one()
+        return int(row.n) if row else 0
+
+    def delete(self, table: str, doc_ids: Iterable[str]) -> int:
+        # Existence-check first so the return value matches the memory
+        # backend's "rows actually removed" contract.
+        self._ensure_table(table)
+        n = 0
+        for did in doc_ids:
+            row = self._session.execute(
+                f"SELECT row_id FROM {self._ks}.{table} WHERE row_id = %s", (did,)
+            ).one()
+            if row is None:
+                continue
+            self._session.execute(f"DELETE FROM {self._ks}.{table} WHERE row_id = %s", (did,))
+            n += 1
+        return n
+
+    def tables(self) -> list[str]:
+        rows = self._session.execute(
+            "SELECT table_name FROM system_schema.tables WHERE keyspace_name = %s", (self._ks,)
+        )
+        return sorted(r.table_name for r in rows)
+
+    def health(self) -> dict:
+        # Connectivity probe only: COUNT(*) per table is a full scan that can
+        # itself time out at scale and flap the liveness probe.
+        try:
+            self._session.execute("SELECT release_version FROM system.local")
+            return {"status": "UP", "tables": {t: -1 for t in self.tables()}}
+        except Exception as exc:  # noqa: BLE001 - health must not raise
+            return {"status": "DOWN", "error": str(exc)}

@@ -1,0 +1,51 @@
+"""Store backend selection (STORE_BACKEND env: memory | native | cassandra)."""
+
+from __future__ import annotations
+
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.store.base import VectorStore
+
+_store: VectorStore | None = None
+
+
+def get_store() -> VectorStore:
+    global _store
+    if _store is None:
+        _store = _build()
+    return _store
+
+
+def reset_store() -> None:
+    global _store
+    _store = None
+
+
+def set_store(store: VectorStore) -> None:
+    """Inject a store (tests / embedded deployments)."""
+    global _store
+    _store = store
+
+
+def _build() -> VectorStore:
+    s = get_settings()
+    backend = s.store_backend.lower()
+    if backend == "memory":
+        from githubrepostorag_tpu.store.memory import MemoryVectorStore
+
+        return MemoryVectorStore(persist_dir=s.store_path or None)
+    if backend == "native":
+        from githubrepostorag_tpu.store.native import NativeVectorStore
+
+        return NativeVectorStore(persist_dir=s.store_path or None)
+    if backend == "cassandra":
+        from githubrepostorag_tpu.store.cassandra import CassandraVectorStore
+
+        return CassandraVectorStore(
+            hosts=[s.cassandra_host],
+            port=s.cassandra_port,
+            username=s.cassandra_username,
+            password=s.cassandra_password,
+            keyspace=s.cassandra_keyspace,
+            embed_dim=s.embed_dim,
+        )
+    raise ValueError(f"Unknown STORE_BACKEND: {s.store_backend!r}")

@@ -1,0 +1,120 @@
+"""Native-accelerated local vector store.
+
+Same semantics as MemoryVectorStore, with the hot scoring loop delegated to
+the in-tree C++ SIMD kernel (native/vecsearch.cpp) via ctypes when the shared
+library has been built (``make -C native`` or the lazy auto-build below).
+Falls back to the numpy path transparently when the library is unavailable,
+so STORE_BACKEND=native is always safe to select.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Mapping
+
+import numpy as np
+
+from githubrepostorag_tpu.store.base import SearchHit, _match
+from githubrepostorag_tpu.store.memory import MemoryVectorStore
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_NAME = "libvecsearch.so"
+
+
+def _load_library() -> ctypes.CDLL | None:
+    lib_path = _NATIVE_DIR / _LIB_NAME
+    if not lib_path.exists():
+        src = _NATIVE_DIR / "vecsearch.cpp"
+        if not src.exists():
+            return None
+        try:  # lazy one-shot build; failure is non-fatal
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR), _LIB_NAME],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, OSError) as exc:
+            logger.warning("native vecsearch build failed, using numpy path: %s", exc)
+            return None
+    try:
+        lib = ctypes.CDLL(str(lib_path))
+        lib.topk_cosine.argtypes = [
+            ctypes.POINTER(ctypes.c_float),  # row-normalized matrix [n, d]
+            ctypes.c_int,  # n
+            ctypes.c_int,  # d
+            ctypes.POINTER(ctypes.c_float),  # normalized query [d]
+            ctypes.c_int,  # k
+            ctypes.POINTER(ctypes.c_int),  # out indices [k]
+            ctypes.POINTER(ctypes.c_float),  # out scores [k]
+        ]
+        lib.topk_cosine.restype = ctypes.c_int
+        return lib
+    except OSError as exc:  # pragma: no cover
+        logger.warning("native vecsearch load failed, using numpy path: %s", exc)
+        return None
+
+
+_lib: ctypes.CDLL | None = None
+_lib_checked = False
+
+
+def _get_lib() -> ctypes.CDLL | None:
+    global _lib, _lib_checked
+    if not _lib_checked:
+        _lib = _load_library()
+        _lib_checked = True
+    return _lib
+
+
+class NativeVectorStore(MemoryVectorStore):
+    def search(
+        self,
+        table: str,
+        query_vector: np.ndarray,
+        k: int,
+        filter: Mapping[str, str] | None = None,
+    ) -> list[SearchHit]:
+        lib = _get_lib()
+        if lib is None:
+            return super().search(table, query_vector, k, filter)
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None:
+                return []
+            mat, ids = t.matrix()
+            n = mat.shape[0]
+            if n == 0:
+                return []
+            q = np.asarray(query_vector, dtype=np.float32).reshape(-1)
+            qn = np.linalg.norm(q)
+            if qn == 0:
+                return []
+            q = np.ascontiguousarray(q / qn)
+            mat = np.ascontiguousarray(mat)
+            # over-fetch so post-filtering can still fill k
+            fetch = n if filter else min(n, max(k, 16))
+            out_idx = np.empty(fetch, dtype=np.int32)
+            out_score = np.empty(fetch, dtype=np.float32)
+            got = lib.topk_cosine(
+                mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                n,
+                mat.shape[1],
+                q.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                fetch,
+                out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+                out_score.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            )
+            hits: list[SearchHit] = []
+            for i in range(got):
+                doc = t.docs[ids[out_idx[i]]]
+                if _match(doc.metadata, filter):
+                    hits.append(SearchHit(doc=doc, score=float(out_score[i])))
+                    if len(hits) >= k:
+                        break
+            return hits

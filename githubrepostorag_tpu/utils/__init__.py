@@ -1,0 +1,4 @@
+from githubrepostorag_tpu.utils.json_utils import extract_json, extract_choice
+from githubrepostorag_tpu.utils.logging import get_logger
+
+__all__ = ["extract_json", "extract_choice", "get_logger"]

@@ -1,0 +1,19 @@
+"""Stdlib logging setup honoring LOG_LEVEL (rag_shared/config.py:9)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_configured = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _configured
+    if not _configured:
+        logging.basicConfig(
+            level=os.getenv("LOG_LEVEL", "INFO").upper(),
+            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        )
+        _configured = True
+    return logging.getLogger(name)

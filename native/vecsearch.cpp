@@ -1,0 +1,59 @@
+// Brute-force top-k cosine scoring for the local vector store.
+//
+// The matrix is row-normalized float32 [n, d] and the query is normalized
+// [d], so cosine similarity reduces to a dot product. Compiled with -O3
+// -march=native so the inner loop auto-vectorizes (AVX2/AVX-512 on x86,
+// NEON on ARM). Exposed via ctypes from
+// githubrepostorag_tpu/store/native.py.
+
+#include <algorithm>
+#include <cstdint>
+#include <queue>
+#include <utility>
+#include <vector>
+
+extern "C" {
+
+// Returns the number of results written (min(k, n)).
+int topk_cosine(const float* matrix, int n, int d, const float* query, int k,
+                int* out_indices, float* out_scores) {
+  if (n <= 0 || d <= 0 || k <= 0) return 0;
+  k = std::min(k, n);
+
+  // min-heap of (score, index): smallest retained score at the top.
+  using Entry = std::pair<float, int>;
+  std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap;
+
+  for (int row = 0; row < n; ++row) {
+    const float* v = matrix + static_cast<int64_t>(row) * d;
+    float acc = 0.0f;
+#pragma omp simd reduction(+ : acc)
+    for (int j = 0; j < d; ++j) acc += v[j] * query[j];
+    if (static_cast<int>(heap.size()) < k) {
+      heap.emplace(acc, row);
+    } else if (acc > heap.top().first) {
+      heap.pop();
+      heap.emplace(acc, row);
+    }
+  }
+
+  int count = static_cast<int>(heap.size());
+  for (int i = count - 1; i >= 0; --i) {
+    out_scores[i] = heap.top().first;
+    out_indices[i] = heap.top().second;
+    heap.pop();
+  }
+  return count;
+}
+
+// Batched variant: q queries at once (used by ingest-side dedup checks).
+void topk_cosine_batch(const float* matrix, int n, int d, const float* queries,
+                       int q, int k, int* out_indices, float* out_scores) {
+  for (int i = 0; i < q; ++i) {
+    topk_cosine(matrix, n, d, queries + static_cast<int64_t>(i) * d, k,
+                out_indices + static_cast<int64_t>(i) * k,
+                out_scores + static_cast<int64_t>(i) * k);
+  }
+}
+
+}  // extern "C"

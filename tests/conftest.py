@@ -1,0 +1,45 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh before any jax
+import so sharding tests (pjit/shard_map over a Mesh) run without TPUs, and
+give every test a clean in-process bus/store.
+"""
+
+import os
+import sys
+
+# Must happen before jax initializes its backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests with asyncio.run (no pytest-asyncio in image)."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(func(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Reset process-wide singletons (bus hub, store, settings) per test."""
+    from githubrepostorag_tpu.config import reload_settings
+    from githubrepostorag_tpu.events.memory import reset_memory_hub
+    from githubrepostorag_tpu.store.factory import reset_store
+
+    reload_settings()
+    reset_memory_hub()
+    reset_store()
+    yield
+    reset_memory_hub()
+    reset_store()

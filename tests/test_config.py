@@ -1,0 +1,37 @@
+"""Config: reference env-var names resolve into the unified Settings."""
+
+from githubrepostorag_tpu.config import Settings, get_settings, reload_settings
+
+
+def test_defaults_match_reference():
+    s = Settings()
+    assert s.max_rag_attempts == 3
+    assert s.min_source_nodes == 1
+    assert s.router_top_k == 5
+    assert s.embed_dim == 384
+    assert s.qwen_max_output == 4096
+    assert s.sse_ping_seconds == 15
+    assert s.context_window == 11712
+    assert s.embeddings_table_chunk == "embeddings"
+    assert s.embeddings_table_catalog == "embeddings_catalog"
+
+
+def test_env_overrides(monkeypatch):
+    monkeypatch.setenv("MAX_RAG_ATTEMPTS", "7")
+    monkeypatch.setenv("EMBEDDINGS_TABLE", "alt_embeddings")
+    monkeypatch.setenv("DEV_MODE", "true")
+    s = reload_settings()
+    assert s.max_rag_attempts == 7
+    assert s.embeddings_table_chunk == "alt_embeddings"
+    assert s.dev_force_standalone is True
+
+
+def test_scope_tables_cover_all_five_levels():
+    tables = get_settings().scope_tables
+    assert set(tables) == {"catalog", "repo", "module", "file", "chunk"}
+
+
+def test_bad_env_int_falls_back(monkeypatch):
+    monkeypatch.setenv("ROUTER_TOP_K", "not-a-number")
+    s = reload_settings()
+    assert s.router_top_k == 5

@@ -1,0 +1,98 @@
+"""Event bus: wire-format parity with the reference's Redis pub/sub bus
+(rag_shared/bus.py) plus the replay-buffer improvement and the job queue."""
+
+import asyncio
+import json
+
+import pytest
+
+from githubrepostorag_tpu.events import (
+    MemoryBus,
+    MemoryCancelFlags,
+    MemoryJobQueue,
+    PING_FRAME,
+)
+
+
+async def _collect(bus, job_id, n_frames, timeout=5.0):
+    out = []
+
+    async def consume():
+        async for frame in bus.stream(job_id):
+            out.append(frame)
+            if len([f for f in out if f.startswith("data:")]) >= n_frames:
+                return
+
+    await asyncio.wait_for(consume(), timeout)
+    return out
+
+
+async def test_emit_then_stream_sees_replayed_event():
+    bus = MemoryBus(ping_interval=0.05)
+    await bus.emit("j1", "started", {"job_id": "j1"})
+    frames = await _collect(bus, "j1", 1)
+    datas = [f for f in frames if f.startswith("data:")]
+    payload = json.loads(datas[0][len("data: "):].strip())
+    assert payload == {"event": "started", "data": {"job_id": "j1"}}
+
+
+async def test_live_emit_reaches_subscriber():
+    bus = MemoryBus(ping_interval=0.05)
+
+    async def emitter():
+        await asyncio.sleep(0.05)
+        await bus.emit("j2", "final", {"answer": "42"})
+
+    task = asyncio.create_task(emitter())
+    frames = await _collect(bus, "j2", 1)
+    await task
+    assert any('"final"' in f for f in frames)
+
+
+async def test_ping_frames_flow_when_idle():
+    bus = MemoryBus(ping_interval=0.01)
+    gen = bus.stream("j3")
+    frame = await asyncio.wait_for(gen.__anext__(), 1.0)
+    assert frame == PING_FRAME
+    await gen.aclose()
+
+
+async def test_sse_frame_format():
+    bus = MemoryBus(ping_interval=0.05)
+    await bus.emit("j4", "turn", {"stage": "retrieve"})
+    frames = await _collect(bus, "j4", 1)
+    data = [f for f in frames if f.startswith("data:")][0]
+    assert data.endswith("\n\n")
+
+
+async def test_cancel_flags_roundtrip():
+    flags = MemoryCancelFlags()
+    assert not await flags.is_cancelled("jx")
+    await flags.cancel("jx")
+    assert await flags.is_cancelled("jx")
+    assert not await flags.is_cancelled("other")
+
+
+async def test_job_queue_fifo_and_results():
+    q = MemoryJobQueue()
+    j1 = await q.enqueue_job("run_rag_job", "j-1", {"query": "q"}, _job_id="j-1")
+    await q.enqueue_job("run_rag_job", "j-2", {"query": "r"}, _job_id="j-2")
+    assert j1.job_id == "j-1"
+    first = await q.dequeue()
+    second = await q.dequeue()
+    assert first.job_id == "j-1" and second.job_id == "j-2"
+    assert first.function == "run_rag_job"
+    await q.set_result("j-1", {"answer": "a"})
+    assert await q.get_result("j-1") == {"answer": "a"}
+    assert await q.get_result("missing") is None
+
+
+async def test_multiple_subscribers_both_receive():
+    bus = MemoryBus(ping_interval=0.05)
+    r1 = asyncio.create_task(_collect(bus, "j5", 1))
+    r2 = asyncio.create_task(_collect(bus, "j5", 1))
+    await asyncio.sleep(0.05)
+    await bus.emit("j5", "iteration", {"n": 1})
+    f1, f2 = await asyncio.gather(r1, r2)
+    assert any("iteration" in f for f in f1)
+    assert any("iteration" in f for f in f2)

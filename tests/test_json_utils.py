@@ -1,0 +1,61 @@
+"""LLM-output parsing robustness (the load-bearing fallbacks)."""
+
+from githubrepostorag_tpu.utils.json_utils import (
+    extract_choice,
+    extract_json,
+    sanitize_llm_text,
+    strip_fences,
+    truncate,
+)
+
+
+def test_extract_json_direct():
+    assert extract_json('{"scope": "repo"}') == {"scope": "repo"}
+
+
+def test_extract_json_fenced():
+    text = 'Here you go:\n```json\n{"scope": "file", "filters": {}}\n```\nDone.'
+    assert extract_json(text) == {"scope": "file", "filters": {}}
+
+
+def test_extract_json_embedded_in_prose():
+    text = 'I think the plan is {"scope": "chunk", "filters": {"repo": "x"}} based on the query.'
+    assert extract_json(text) == {"scope": "chunk", "filters": {"repo": "x"}}
+
+
+def test_extract_json_nested_braces_and_strings():
+    text = 'prefix {"a": {"b": "with } brace"}, "c": [1, 2]} suffix'
+    assert extract_json(text) == {"a": {"b": "with } brace"}, "c": [1, 2]}
+
+
+def test_extract_json_garbage_returns_default():
+    assert extract_json("no json here", default={}) == {}
+
+
+def test_sanitize_strips_think_blocks():
+    out = sanitize_llm_text("<think>hmm let me reason</think>The answer is 42.")
+    assert out == "The answer is 42."
+
+
+def test_sanitize_strips_role_markers_and_chatty_prefix():
+    out = sanitize_llm_text("assistant: Sure, here is the summary:\nIt does X.")
+    assert "assistant" not in out.lower()
+    assert "It does X." in out
+
+
+def test_extract_choice_cascade():
+    assert extract_choice("The best choice is 3 because...") == "3"
+    assert extract_choice("2") == "2"
+    assert extract_choice('{"choice": 4}') == "4"
+    assert extract_choice("I pick option (2).") == "2"
+    assert extract_choice("none of the above") == "1"
+    assert extract_choice("") == "1"
+
+
+def test_strip_fences_passthrough():
+    assert strip_fences("plain text") == "plain text"
+
+
+def test_truncate_budget():
+    assert truncate("a" * 100, 10) == "a" * 10
+    assert truncate("short", 10) == "short"

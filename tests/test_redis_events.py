@@ -1,0 +1,68 @@
+"""Redis-backed bus/queue over the in-tree RESP client, against a miniature
+RESP server speaking the real wire protocol over TCP."""
+
+import asyncio
+import json
+
+import pytest
+
+from githubrepostorag_tpu.events.redis import RedisBus, RedisCancelFlags, RedisJobQueue
+from tests.miniredis import MiniRedis
+
+
+# pytest fixtures + our asyncio.run hook can't share a loop, so each test
+# drives its own server inside one coroutine.
+async def _with_server(fn):
+    server = MiniRedis()
+    port = await server.start()
+    try:
+        await fn(f"redis://127.0.0.1:{port}/0")
+    finally:
+        await server.stop()
+
+
+async def test_redis_bus_publish_subscribe_roundtrip():
+    async def body(url):
+        bus = RedisBus(url, ping_interval=0.05)
+        frames = []
+
+        async def subscriber():
+            async for f in bus.stream("j1"):
+                if f.startswith("data:"):
+                    frames.append(f)
+                    return
+
+        task = asyncio.create_task(subscriber())
+        await asyncio.sleep(0.1)  # let SUBSCRIBE land
+        await bus.emit("j1", "final", {"answer": "hi"})
+        await asyncio.wait_for(task, 5)
+        payload = json.loads(frames[0][len("data: "):].strip())
+        assert payload == {"event": "final", "data": {"answer": "hi"}}
+        await bus.close()
+
+    await _with_server(body)
+
+
+async def test_redis_cancel_flags():
+    async def body(url):
+        flags = RedisCancelFlags(url)
+        assert not await flags.is_cancelled("j")
+        await flags.cancel("j")
+        assert await flags.is_cancelled("j")
+
+    await _with_server(body)
+
+
+async def test_redis_job_queue_roundtrip():
+    async def body(url):
+        q = RedisJobQueue(url)
+        job = await q.enqueue_job("run_rag_job", "j-1", {"query": "x"}, _job_id="j-1")
+        assert job.job_id == "j-1"
+        got = await asyncio.wait_for(q.dequeue(), 5)
+        assert got.job_id == "j-1"
+        assert got.function == "run_rag_job"
+        assert got.args == ("j-1", {"query": "x"})
+        await q.set_result("j-1", {"answer": "done"})
+        assert await q.get_result("j-1") == {"answer": "done"}
+
+    await _with_server(body)

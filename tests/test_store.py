@@ -1,0 +1,86 @@
+"""Vector store: cosine ANN, metadata filters, idempotent upsert, persistence."""
+
+import numpy as np
+import pytest
+
+from githubrepostorag_tpu.store import Doc, MemoryVectorStore
+
+
+def _doc(i, vec, **meta):
+    return Doc(doc_id=f"d{i}", text=f"text {i}", metadata={k: str(v) for k, v in meta.items()},
+               vector=np.asarray(vec, dtype=np.float32))
+
+
+def test_search_ranks_by_cosine():
+    s = MemoryVectorStore()
+    s.upsert("embeddings", [
+        _doc(0, [1, 0, 0]),
+        _doc(1, [0.9, 0.1, 0]),
+        _doc(2, [0, 1, 0]),
+    ])
+    hits = s.search("embeddings", np.array([1.0, 0.0, 0.0]), k=2)
+    assert [h.doc.doc_id for h in hits] == ["d0", "d1"]
+    assert hits[0].score == pytest.approx(1.0, abs=1e-6)
+
+
+def test_metadata_filter_restricts_results():
+    s = MemoryVectorStore()
+    s.upsert("embeddings", [
+        _doc(0, [1, 0], repo="alpha", namespace="default"),
+        _doc(1, [1, 0], repo="beta", namespace="default"),
+    ])
+    hits = s.search("embeddings", np.array([1.0, 0.0]), k=5, filter={"repo": "alpha"})
+    assert [h.doc.doc_id for h in hits] == ["d0"]
+
+
+def test_upsert_is_idempotent():
+    s = MemoryVectorStore()
+    s.upsert("embeddings", [_doc(0, [1, 0])])
+    s.upsert("embeddings", [_doc(0, [0, 1])])  # same id, new vector
+    assert s.count("embeddings") == 1
+    hits = s.search("embeddings", np.array([0.0, 1.0]), k=1)
+    assert hits[0].score == pytest.approx(1.0, abs=1e-6)
+
+
+def test_find_by_metadata_edge_traversal():
+    s = MemoryVectorStore()
+    s.upsert("embeddings_file", [
+        _doc(0, [1, 0], module="ingest", repo="r1"),
+        _doc(1, [0, 1], module="ingest", repo="r1"),
+        _doc(2, [0, 1], module="api", repo="r1"),
+    ])
+    adjacent = s.find_by_metadata("embeddings_file", {"module": "ingest"})
+    assert {d.doc_id for d in adjacent} == {"d0", "d1"}
+
+
+def test_delete_and_count():
+    s = MemoryVectorStore()
+    s.upsert("t", [_doc(0, [1]), _doc(1, [1])])
+    assert s.delete("t", ["d0", "nope"]) == 1
+    assert s.count("t") == 1
+
+
+def test_docs_without_vectors_are_stored_but_not_searched():
+    s = MemoryVectorStore()
+    s.upsert("t", [Doc("raw", "no vector yet"), _doc(1, [1, 0])])
+    assert s.count("t") == 2
+    hits = s.search("t", np.array([1.0, 0.0]), k=10)
+    assert [h.doc.doc_id for h in hits] == ["d1"]
+
+
+def test_persistence_roundtrip(tmp_path):
+    s = MemoryVectorStore(persist_dir=str(tmp_path))
+    s.upsert("embeddings", [_doc(0, [1, 0], repo="alpha")])
+    s.save()
+    s2 = MemoryVectorStore(persist_dir=str(tmp_path))
+    assert s2.count("embeddings") == 1
+    hit = s2.search("embeddings", np.array([1.0, 0.0]), k=1)[0]
+    assert hit.doc.metadata["repo"] == "alpha"
+
+
+def test_health_reports_tables():
+    s = MemoryVectorStore()
+    s.upsert("embeddings", [_doc(0, [1])])
+    h = s.health()
+    assert h["status"] == "UP"
+    assert h["tables"] == {"embeddings": 1}
