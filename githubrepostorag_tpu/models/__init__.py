@@ -1,0 +1,9 @@
+"""Model definitions: the Qwen2-family decoder (serving + training) and the
+BERT-class embedding encoder.  Pure-functional JAX — parameters are pytrees
+of arrays with layers stacked on a leading axis so the layer loop is a
+single ``lax.scan`` (one compile per shape, not per layer) and pjit sharding
+rules apply uniformly across layers."""
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, forward, init_params
+
+__all__ = ["Qwen2Config", "forward", "init_params"]

@@ -1,0 +1,100 @@
+"""Load HuggingFace Qwen2 checkpoints into the stacked-params pytree.
+
+Two entry points:
+  - ``params_from_state_dict`` — from an in-memory state dict (numpy/torch
+    tensors); used by parity tests against ``transformers`` models.
+  - ``load_qwen2`` — from a local checkpoint directory (config.json +
+    safetensors shards).  No network access: weights must already be on
+    disk (MODEL_WEIGHTS_PATH).
+
+HF stores linear weights [out, in]; this framework stores [in, out] so the
+forward pass is ``x @ w``.  Per-layer tensors are stacked on a leading L
+axis for the lax.scan layer loop.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config
+
+
+def _np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor (parity tests) without importing torch here
+    return t.detach().to("cpu").float().numpy()
+
+
+def config_from_hf(hf_cfg: dict) -> Qwen2Config:
+    num_heads = hf_cfg["num_attention_heads"]
+    return Qwen2Config(
+        vocab_size=hf_cfg["vocab_size"],
+        hidden_size=hf_cfg["hidden_size"],
+        intermediate_size=hf_cfg["intermediate_size"],
+        num_layers=hf_cfg["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf_cfg.get("num_key_value_heads", num_heads),
+        head_dim=hf_cfg.get("head_dim") or hf_cfg["hidden_size"] // num_heads,
+        rope_theta=hf_cfg.get("rope_theta", 1_000_000.0),  # HF Qwen2Config default
+        rms_norm_eps=hf_cfg.get("rms_norm_eps", 1e-6),
+        tie_word_embeddings=hf_cfg.get("tie_word_embeddings", False),
+        max_position_embeddings=hf_cfg.get("max_position_embeddings", 32768),
+    )
+
+
+def params_from_state_dict(state_dict: dict, cfg: Qwen2Config, dtype=np.float32) -> dict:
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    L = cfg.num_layers
+
+    def get(name: str) -> np.ndarray:
+        return _np(sd[name])
+
+    def stack_linear(fmt: str) -> np.ndarray:
+        # HF [out, in] -> ours [in, out], stacked [L, in, out]
+        return np.stack([get(fmt.format(i)).T for i in range(L)]).astype(dtype)
+
+    def stack_vec(fmt: str) -> np.ndarray:
+        return np.stack([get(fmt.format(i)) for i in range(L)]).astype(dtype)
+
+    layers = {
+        "ln1": stack_vec("layers.{}.input_layernorm.weight"),
+        "ln2": stack_vec("layers.{}.post_attention_layernorm.weight"),
+        "wq": stack_linear("layers.{}.self_attn.q_proj.weight"),
+        "bq": stack_vec("layers.{}.self_attn.q_proj.bias"),
+        "wk": stack_linear("layers.{}.self_attn.k_proj.weight"),
+        "bk": stack_vec("layers.{}.self_attn.k_proj.bias"),
+        "wv": stack_linear("layers.{}.self_attn.v_proj.weight"),
+        "bv": stack_vec("layers.{}.self_attn.v_proj.bias"),
+        "wo": stack_linear("layers.{}.self_attn.o_proj.weight"),
+        "wg": stack_linear("layers.{}.mlp.gate_proj.weight"),
+        "wu": stack_linear("layers.{}.mlp.up_proj.weight"),
+        "wd": stack_linear("layers.{}.mlp.down_proj.weight"),
+    }
+    params = {
+        "embed": get("embed_tokens.weight").astype(dtype),
+        "layers": layers,
+        "norm": get("norm.weight").astype(dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T.astype(dtype)
+    return params
+
+
+def load_qwen2(checkpoint_dir: str, dtype=np.float32) -> tuple[dict, Qwen2Config]:
+    """Load config.json + *.safetensors from a local directory."""
+    from safetensors import safe_open  # ships with transformers' deps
+
+    root = Path(checkpoint_dir)
+    hf_cfg = json.loads((root / "config.json").read_text())
+    cfg = config_from_hf(hf_cfg)
+
+    state: dict[str, np.ndarray] = {}
+    for shard in sorted(root.glob("*.safetensors")):
+        with safe_open(str(shard), framework="np") as f:
+            for key in f.keys():
+                state[key] = f.get_tensor(key)
+    return params_from_state_dict(state, cfg, dtype=dtype), cfg

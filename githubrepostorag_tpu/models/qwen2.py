@@ -1,0 +1,216 @@
+"""Qwen2-family decoder in pure functional JAX.
+
+Replaces the reference's out-of-tree vLLM serving model
+(helm/templates/qwen-deployment.yaml:21-33 — image vllm/vllm-openai serving
+Qwen2.5-Coder-7B-Instruct-AWQ) with an in-tree implementation designed for
+TPU: bfloat16 activations on the MXU, stacked-layer params scanned with
+``lax.scan``, grouped-query attention without materialized KV repetition,
+and a cache interface the paged serving engine plugs into.
+
+Architecture (matches HF ``Qwen2ForCausalLM``): token embedding, N blocks of
+[RMSNorm -> GQA attention with QKV bias + RoPE -> residual, RMSNorm ->
+SwiGLU MLP -> residual], final RMSNorm, (optionally tied) LM head.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.ops.attention import dense_attention
+from githubrepostorag_tpu.ops.norms import rms_norm
+from githubrepostorag_tpu.ops.rope import apply_rope, rope_cos_sin
+
+
+@dataclass(frozen=True)
+class Qwen2Config:
+    vocab_size: int = 151936
+    hidden_size: int = 896
+    intermediate_size: int = 4864
+    num_layers: int = 24
+    num_heads: int = 14
+    num_kv_heads: int = 2
+    head_dim: int = 64
+    rope_theta: float = 1_000_000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True
+    max_position_embeddings: int = 32768
+
+    # ---- presets (HF config.json values for the eval-config model family) --
+
+    @classmethod
+    def tiny(cls) -> "Qwen2Config":
+        """Test-scale config (CI / parity tests)."""
+        return cls(
+            vocab_size=512,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            rope_theta=10_000.0,
+            tie_word_embeddings=True,
+            max_position_embeddings=512,
+        )
+
+    @classmethod
+    def qwen2_0_5b(cls) -> "Qwen2Config":
+        return cls(
+            hidden_size=896, intermediate_size=4864, num_layers=24,
+            num_heads=14, num_kv_heads=2, head_dim=64, tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def qwen2_1_5b(cls) -> "Qwen2Config":
+        return cls(
+            hidden_size=1536, intermediate_size=8960, num_layers=28,
+            num_heads=12, num_kv_heads=2, head_dim=128, tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def qwen2_7b(cls) -> "Qwen2Config":
+        return cls(
+            hidden_size=3584, intermediate_size=18944, num_layers=28,
+            num_heads=28, num_kv_heads=4, head_dim=128, tie_word_embeddings=False,
+            vocab_size=152064,
+        )
+
+
+def init_params(cfg: Qwen2Config, key: jax.Array, dtype=jnp.float32) -> dict:
+    """Random init (normal 0.02, the HF default) with stacked layer leaves."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, nq, nkv, hd, inter, L = (
+        cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+        cfg.intermediate_size, cfg.num_layers,
+    )
+
+    def norm(key, *shape):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * 0.02).astype(dtype)
+
+    keys = jax.random.split(k_layers, 9)
+    layers = {
+        "ln1": jnp.ones((L, d), dtype=dtype),
+        "ln2": jnp.ones((L, d), dtype=dtype),
+        "wq": norm(keys[0], L, d, nq * hd),
+        "bq": jnp.zeros((L, nq * hd), dtype=dtype),
+        "wk": norm(keys[1], L, d, nkv * hd),
+        "bk": jnp.zeros((L, nkv * hd), dtype=dtype),
+        "wv": norm(keys[2], L, d, nkv * hd),
+        "bv": jnp.zeros((L, nkv * hd), dtype=dtype),
+        "wo": norm(keys[3], L, nq * hd, d),
+        "wg": norm(keys[4], L, d, inter),
+        "wu": norm(keys[5], L, d, inter),
+        "wd": norm(keys[6], L, inter, d),
+    }
+    params = {
+        "embed": norm(k_embed, cfg.vocab_size, d),
+        "layers": layers,
+        "norm": jnp.ones((d,), dtype=dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm(k_head, d, cfg.vocab_size)
+    return params
+
+
+def _block(cfg: Qwen2Config, h, p, cos, sin, cache_k, cache_v, kv_lengths):
+    """One transformer block.  cache_k/v are [B, S_cache, n_kv, hd] slices for
+    this layer (None for the cache-free path); kv_lengths [B] counts tokens
+    already present.  Returns (h, new_k, new_v) where new_k/v are this step's
+    K/V ([B, S, n_kv, hd]) for the caller to commit into its cache."""
+    b, s, d = h.shape
+    nq, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    hn = rms_norm(h, p["ln1"], cfg.rms_norm_eps)
+    q = (hn @ p["wq"] + p["bq"]).reshape(b, s, nq, hd)
+    k = (hn @ p["wk"] + p["bk"]).reshape(b, s, nkv, hd)
+    v = (hn @ p["wv"] + p["bv"]).reshape(b, s, nkv, hd)
+    q, k = apply_rope(q, k, cos, sin)
+
+    if cache_k is None:
+        attn = dense_attention(q, k, v, causal=True, q_offset=0)
+    else:
+        # Commit new k/v at each row's current length, then attend over the
+        # full cache with per-row validity masking.
+        def write(cache, new, start):
+            return jax.lax.dynamic_update_slice(cache, new.astype(cache.dtype), (start, 0, 0))
+
+        cache_k = jax.vmap(write)(cache_k, k, kv_lengths)
+        cache_v = jax.vmap(write)(cache_v, v, kv_lengths)
+        attn = dense_attention(
+            q, cache_k, cache_v,
+            causal=True,
+            q_offset=kv_lengths,
+            kv_lengths=kv_lengths + s,
+        )
+        k, v = cache_k, cache_v
+
+    h = h + attn.reshape(b, s, nq * hd) @ p["wo"]
+
+    hn = rms_norm(h, p["ln2"], cfg.rms_norm_eps)
+    h = h + (jax.nn.silu(hn @ p["wg"]) * (hn @ p["wu"])) @ p["wd"]
+    return h, k, v
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward(
+    params: dict,
+    cfg: Qwen2Config,
+    input_ids: jnp.ndarray,  # [B, S] int32
+    positions: jnp.ndarray,  # [B, S] int32
+    cache_k: jnp.ndarray | None = None,  # [L, B, S_cache, n_kv, hd]
+    cache_v: jnp.ndarray | None = None,
+    kv_lengths: jnp.ndarray | None = None,  # [B] tokens already cached
+):
+    """Full forward pass -> (logits [B, S, V] float32, (cache_k, cache_v)).
+
+    Without a cache: plain causal attention over the input (training /
+    scoring / parity tests).  With a cache: incremental prefill or decode —
+    new K/V are written at each row's ``kv_lengths`` offset and attention
+    covers the whole cache.
+
+    Caller contract: ``kv_lengths + S`` must not exceed the cache's length
+    axis.  ``dynamic_update_slice`` clamps out-of-range starts, which would
+    silently corrupt the newest cache entries — the serving scheduler
+    (serving/scheduler.py) enforces the bound before dispatch.
+    """
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    use_cache = cache_k is not None
+    if use_cache:
+        xs = (params["layers"], cache_k, cache_v)
+    else:
+        xs = (params["layers"],)
+
+    def body(h, layer_xs):
+        if use_cache:
+            p, ck, cv = layer_xs
+            h, new_k, new_v = _block(cfg, h, p, cos, sin, ck, cv, kv_lengths)
+            return h, (new_k, new_v)
+        (p,) = layer_xs
+        h, _, _ = _block(cfg, h, p, cos, sin, None, None, None)
+        return h, None
+
+    h, cache_out = jax.lax.scan(body, h, xs)
+    h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
+
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    else:
+        logits = h.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+
+    if use_cache:
+        new_k, new_v = cache_out
+        return logits, (new_k, new_v)
+    return logits, None
+
+
+def make_dense_cache(cfg: Qwen2Config, batch: int, max_len: int, dtype=jnp.bfloat16):
+    """Allocate a contiguous per-layer KV cache [L, B, max_len, n_kv, hd]."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)

@@ -1,0 +1,8 @@
+"""TPU compute ops: norms, rotary embeddings, attention (dense + paged
+Pallas), sampling.  Everything here is jit-safe (static shapes, no Python
+control flow on traced values) and bfloat16-friendly."""
+
+from githubrepostorag_tpu.ops.norms import rms_norm
+from githubrepostorag_tpu.ops.rope import apply_rope, rope_cos_sin
+
+__all__ = ["rms_norm", "apply_rope", "rope_cos_sin"]

@@ -1,0 +1,20 @@
+"""RMSNorm (the Qwen2/Llama family normalization).
+
+Computed in float32 regardless of input dtype — the variance accumulation
+underflows in bfloat16 — then cast back before the weight multiply,
+matching HF's Qwen2RMSNorm numerics so logits-parity tests against the
+reference model hold.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return normed.astype(orig_dtype) * weight

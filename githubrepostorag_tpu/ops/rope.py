@@ -1,0 +1,31 @@
+"""Rotary position embeddings (rotate-half convention, Llama/Qwen2 family).
+
+cos/sin are computed in float32 from integer positions so decode steps at
+position 30k+ keep full precision, then applied in the activation dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float = 10000.0):
+    """positions [B, S] (int32) -> cos, sin each [B, S, head_dim]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, hd/2]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # [B, S, hd]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(q: jnp.ndarray, k: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
+    """q [B, S, n_q, hd], k [B, S, n_kv, hd]; cos/sin [B, S, hd]."""
+    cos = cos[:, :, None, :].astype(q.dtype)
+    sin = sin[:, :, None, :].astype(q.dtype)
+    q_out = q * cos + _rotate_half(q) * sin
+    k_out = k * cos + _rotate_half(k) * sin
+    return q_out, k_out

@@ -6,13 +6,23 @@ give every test a clean in-process bus/store.
 import os
 import sys
 
-# Must happen before jax initializes its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests always run on a virtual 8-device CPU mesh; the real chip is for
+# bench.py only.  The env vars must be set before jax initializes its
+# backends, and because this machine's sitecustomize imports jax at
+# interpreter startup (pinning JAX_PLATFORMS=axon -> the TPU), we must ALSO
+# override via jax.config after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
+assert jax.device_count() == 8, "tests expect the virtual 8-device CPU mesh"
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
