@@ -116,11 +116,12 @@ def init_params(cfg: Qwen2Config, key: jax.Array, dtype=jnp.float32) -> dict:
     return params
 
 
-def _block(cfg: Qwen2Config, h, p, cos, sin, cache_k, cache_v, kv_lengths):
-    """One transformer block.  cache_k/v are [B, S_cache, n_kv, hd] slices for
-    this layer (None for the cache-free path); kv_lengths [B] counts tokens
-    already present.  Returns (h, new_k, new_v) where new_k/v are this step's
-    K/V ([B, S, n_kv, hd]) for the caller to commit into its cache."""
+def _block(cfg: Qwen2Config, h, p, cos, sin, attend):
+    """One transformer block.  ``attend(q, k, v) -> (attn_out, cache_info)``
+    commits this step's K/V into whatever cache representation the caller
+    uses (dense slab, page pool, or nothing) and returns the attention
+    output.  Both the dense and paged forward paths share this body, so
+    projection/RoPE/MLP changes cannot drift between them."""
     b, s, d = h.shape
     nq, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -130,29 +131,12 @@ def _block(cfg: Qwen2Config, h, p, cos, sin, cache_k, cache_v, kv_lengths):
     v = (hn @ p["wv"] + p["bv"]).reshape(b, s, nkv, hd)
     q, k = apply_rope(q, k, cos, sin)
 
-    if cache_k is None:
-        attn = dense_attention(q, k, v, causal=True, q_offset=0)
-    else:
-        # Commit new k/v at each row's current length, then attend over the
-        # full cache with per-row validity masking.
-        def write(cache, new, start):
-            return jax.lax.dynamic_update_slice(cache, new.astype(cache.dtype), (start, 0, 0))
-
-        cache_k = jax.vmap(write)(cache_k, k, kv_lengths)
-        cache_v = jax.vmap(write)(cache_v, v, kv_lengths)
-        attn = dense_attention(
-            q, cache_k, cache_v,
-            causal=True,
-            q_offset=kv_lengths,
-            kv_lengths=kv_lengths + s,
-        )
-        k, v = cache_k, cache_v
-
+    attn, cache_info = attend(q, k, v)
     h = h + attn.reshape(b, s, nq * hd) @ p["wo"]
 
     hn = rms_norm(h, p["ln2"], cfg.rms_norm_eps)
     h = h + (jax.nn.silu(hn @ p["wg"]) * (hn @ p["wu"])) @ p["wd"]
-    return h, k, v
+    return h, cache_info
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -174,11 +158,12 @@ def forward(
 
     Caller contract: ``kv_lengths + S`` must not exceed the cache's length
     axis.  ``dynamic_update_slice`` clamps out-of-range starts, which would
-    silently corrupt the newest cache entries — the serving scheduler
-    (serving/scheduler.py) enforces the bound before dispatch.
+    silently corrupt the newest cache entries — the serving engine
+    (serving/engine.py) enforces the bound before dispatch.
     """
     h = jnp.take(params["embed"], input_ids, axis=0)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    s = input_ids.shape[1]
 
     use_cache = cache_k is not None
     if use_cache:
@@ -189,10 +174,33 @@ def forward(
     def body(h, layer_xs):
         if use_cache:
             p, ck, cv = layer_xs
-            h, new_k, new_v = _block(cfg, h, p, cos, sin, ck, cv, kv_lengths)
-            return h, (new_k, new_v)
+
+            def attend(q, k, v):
+                # Commit new k/v at each row's current length, then attend
+                # over the full cache with per-row validity masking.
+                def write(cache, new, start):
+                    return jax.lax.dynamic_update_slice(
+                        cache, new.astype(cache.dtype), (start, 0, 0)
+                    )
+
+                new_ck = jax.vmap(write)(ck, k, kv_lengths)
+                new_cv = jax.vmap(write)(cv, v, kv_lengths)
+                attn = dense_attention(
+                    q, new_ck, new_cv,
+                    causal=True,
+                    q_offset=kv_lengths,
+                    kv_lengths=kv_lengths + s,
+                )
+                return attn, (new_ck, new_cv)
+
+            h, cache_info = _block(cfg, h, p, cos, sin, attend)
+            return h, cache_info
+
         (p,) = layer_xs
-        h, _, _ = _block(cfg, h, p, cos, sin, None, None, None)
+        h, _ = _block(
+            cfg, h, p, cos, sin,
+            lambda q, k, v: (dense_attention(q, k, v, causal=True, q_offset=0), None),
+        )
         return h, None
 
     h, cache_out = jax.lax.scan(body, h, xs)
@@ -214,3 +222,73 @@ def make_dense_cache(cfg: Qwen2Config, batch: int, max_len: int, dtype=jnp.bfloa
     """Allocate a contiguous per-layer KV cache [L, B, max_len, n_kv, hd]."""
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
     return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg", "use_pallas"), donate_argnums=(4, 5))
+def forward_paged(
+    params: dict,
+    cfg: Qwen2Config,
+    input_ids: jnp.ndarray,  # [B, S] int32, right-padded per row
+    positions: jnp.ndarray,  # [B, S] int32 absolute positions
+    k_pages: jnp.ndarray,  # [L, n_kv, P, page_size, hd] (donated)
+    v_pages: jnp.ndarray,  # (donated)
+    slot_mapping: jnp.ndarray,  # [B, S] int32 flat pool slots, -1 for padding
+    block_tables: jnp.ndarray,  # [B, max_pages] int32
+    cached_lens: jnp.ndarray,  # [B] tokens already in cache before this step
+    new_lens: jnp.ndarray,  # [B] valid new tokens this step
+    use_pallas: bool = False,
+):
+    """Prefill-chunk or decode step over the paged KV cache.
+
+    New K/V are scattered into the page pools at ``slot_mapping`` (padding
+    slots are -1 and dropped), then attention runs over each row's block
+    table.  Returns (logits [B, S, V] float32, k_pages, v_pages) — the pools
+    are donated so XLA updates them in place.
+    """
+    from githubrepostorag_tpu.ops.paged_attention import paged_attention_ref
+
+    if use_pallas:
+        from githubrepostorag_tpu.ops.pallas_paged import paged_attention as attn_fn
+    else:
+        attn_fn = paged_attention_ref
+
+    b, s = input_ids.shape
+    nkv, hd = cfg.num_kv_heads, cfg.head_dim
+    num_pages, page_size = k_pages.shape[2], k_pages.shape[3]
+    total_slots = num_pages * page_size
+
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    # Padding slots arrive as -1; JAX scatter *wraps* negative indices (it
+    # only drops indices >= size), so map them to an out-of-range positive
+    # sentinel that mode="drop" actually drops.
+    flat_slots = slot_mapping.reshape(-1)  # [B*S]
+    flat_slots = jnp.where(flat_slots < 0, total_slots, flat_slots)
+
+    def body(h, layer_xs):
+        p, kp, vp = layer_xs
+
+        def attend(q, k, v):
+            # [n_kv, P*ps, hd] flat view; one slot vector shared by all heads
+            kp_flat = kp.reshape(nkv, total_slots, hd)
+            vp_flat = vp.reshape(nkv, total_slots, hd)
+            k_t = k.reshape(-1, nkv, hd).swapaxes(0, 1).astype(kp.dtype)  # [n_kv, B*S, hd]
+            v_t = v.reshape(-1, nkv, hd).swapaxes(0, 1).astype(vp.dtype)
+            kp_flat = kp_flat.at[:, flat_slots].set(k_t, mode="drop")
+            vp_flat = vp_flat.at[:, flat_slots].set(v_t, mode="drop")
+            new_kp = kp_flat.reshape(nkv, num_pages, page_size, hd)
+            new_vp = vp_flat.reshape(nkv, num_pages, page_size, hd)
+            attn = attn_fn(q, new_kp, new_vp, block_tables, cached_lens, new_lens)
+            return attn, (new_kp, new_vp)
+
+        return _block(cfg, h, p, cos, sin, attend)
+
+    h, (k_pages, v_pages) = jax.lax.scan(body, h, (params["layers"], k_pages, v_pages))
+    h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
+
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        logits = h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    else:
+        logits = h.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+    return logits, k_pages, v_pages

@@ -1,0 +1,54 @@
+"""Paged attention over the page-pool KV cache.
+
+Two implementations with one contract:
+  - ``paged_attention_ref`` — gather the sequence's pages into a contiguous
+    [B, S_max] view and run dense attention.  Always correct, materializes
+    the gathered KV in HBM; used on CPU tests and as the Pallas kernel's
+    oracle.
+  - ``paged_attention`` (ops/pallas_paged.py) — the TPU kernel: walks the
+    block table page by page in VMEM with an online-softmax accumulator, so
+    nothing is materialized.  Falls back to the reference path off-TPU.
+
+Contract (both): q for ONE new-token step per row plus optional chunk width:
+  q            [B, S, n_q, hd]  — new queries (right-padded per row)
+  k_pages      [n_kv, P, page_size, hd] — this layer's pool
+  v_pages      [n_kv, P, page_size, hd]
+  block_tables [B, max_pages]   int32 — page ids per row
+  cached_lens  [B] int32        — tokens already in cache BEFORE this step
+  new_lens     [B] int32        — valid new tokens this step (<= S)
+Returns [B, S, n_q, hd].  Rows attend to their cache prefix plus the causal
+part of the new chunk; padded queries/kv are masked.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.ops.attention import dense_attention
+
+
+def gather_kv(k_pages, v_pages, block_tables):
+    """[n_kv, P, ps, hd] + [B, max_pages] -> [B, max_pages*ps, n_kv, hd]."""
+    b, max_pages = block_tables.shape
+    n_kv, _, ps, hd = k_pages.shape
+
+    def gather(pages):
+        g = pages[:, block_tables]  # [n_kv, B, max_pages, ps, hd]
+        g = jnp.moveaxis(g, 0, 3)  # [B, max_pages, ps, n_kv, hd]
+        return g.reshape(b, max_pages * ps, n_kv, hd)
+
+    return gather(k_pages), gather(v_pages)
+
+
+def paged_attention_ref(q, k_pages, v_pages, block_tables, cached_lens, new_lens):
+    k, v = gather_kv(k_pages, v_pages, block_tables)
+    # The new tokens are already scattered into the pages before attention,
+    # so the valid kv length is cached + new.
+    return dense_attention(
+        q,
+        k,
+        v,
+        causal=True,
+        q_offset=cached_lens,
+        kv_lengths=cached_lens + new_lens,
+    )

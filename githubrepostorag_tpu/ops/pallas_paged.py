@@ -1,0 +1,170 @@
+"""Pallas TPU paged-attention decode kernel (flash-decoding over the block
+table).
+
+In-tree replacement for the PagedAttention CUDA kernel vLLM brings to the
+reference deployment (helm/templates/qwen-deployment.yaml).  One grid step
+processes one (sequence, kv-head, page) triple: the page's K/V slab is
+DMA'd into VMEM by the Pallas pipeline (double-buffered automatically via
+the BlockSpec index map, which reads the *scalar-prefetched* block table),
+scores for the kv-head's query group hit the MXU, and an online-softmax
+accumulator in VMEM scratch carries (m, l, acc) across the page walk.
+Nothing is ever materialized in HBM — the gather-based reference path
+(ops/paged_attention.py) exists only as the correctness oracle.
+
+Contract matches paged_attention_ref for the decode shape S == 1:
+  q            [B, 1, n_q, hd]
+  k_pages      [n_kv, P, page_size, hd]   (one layer's pool)
+  v_pages      [n_kv, P, page_size, hd]
+  block_tables [B, max_pages] int32
+  cached_lens  [B] int32  (tokens in cache BEFORE this step)
+  new_lens     [B] int32  (1 for active rows, 0 for padding rows)
+Returns [B, 1, n_q, hd] in q.dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_pages] SMEM
+    total_lens_ref,  # [B] SMEM
+    # blocks
+    q_ref,  # [1, 1, group, hd] VMEM
+    k_ref,  # [1, 1, page_size, hd] VMEM (one page, one kv head)
+    v_ref,  # [1, 1, page_size, hd] VMEM
+    out_ref,  # [1, 1, group, hd] VMEM
+    # scratch
+    m_ref,  # [group, 128] f32
+    l_ref,  # [group, 128] f32
+    acc_ref,  # [group, hd] f32
+    *,
+    page_size: int,
+    scale: float,
+):
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+    num_pi = pl.num_programs(2)
+
+    @pl.when(pi == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    total = total_lens_ref[bi]  # valid kv length for this row
+    page_start = pi * page_size
+
+    @pl.when(page_start < total)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)  # [group, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page_size, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [group, page_size]
+        kv_pos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kv_pos < total, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [group, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [group, page_size]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when(pi == num_pi - 1)
+    def _():
+        # padding rows never hit the accumulate branch; guard the 0/0
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_ref[...] / safe_l).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_decode(
+    q: jnp.ndarray,  # [B, 1, n_q, hd]
+    k_pages: jnp.ndarray,  # [n_kv, P, page_size, hd]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_pages]
+    cached_lens: jnp.ndarray,  # [B]
+    new_lens: jnp.ndarray,  # [B]
+    interpret: bool = False,
+) -> jnp.ndarray:
+    b, s, n_q, hd = q.shape
+    assert s == 1, "pallas kernel is the decode path (S == 1)"
+    n_kv, num_pages, page_size, _ = k_pages.shape
+    group = n_q // n_kv
+    max_pages = block_tables.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+
+    total_lens = (cached_lens + new_lens).astype(jnp.int32)
+    q_r = q.reshape(b, n_kv, group, hd)
+
+    grid = (b, n_kv, max_pages)
+
+    def q_map(bi, hi, pi, bt, tl):
+        return (bi, hi, 0, 0)
+
+    def kv_map(bi, hi, pi, bt, tl):
+        # Clamp the walk to allocated pages: beyond the row's length the
+        # kernel skips compute, so any valid page id works — reuse page 0.
+        page = jax.lax.select(pi * page_size < tl[bi], bt[bi, pi], 0)
+        return (hi, page, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, group, hd), q_map),
+            pl.BlockSpec((1, 1, page_size, hd), kv_map),
+            pl.BlockSpec((1, 1, page_size, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, hd), jnp.float32),
+        ],
+    )
+
+    kernel = functools.partial(_decode_kernel, page_size=page_size, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, group, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), total_lens, q_r, k_pages, v_pages)
+
+    return out.reshape(b, 1, n_q, hd)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, cached_lens, new_lens):
+    """Dispatcher with the paged_attention_ref contract: Pallas for decode
+    steps, gather+dense for prefill chunks (S > 1)."""
+    from githubrepostorag_tpu.ops.paged_attention import paged_attention_ref
+
+    if q.shape[1] == 1:
+        interpret = jax.default_backend() != "tpu"
+        return paged_attention_decode(
+            q, k_pages, v_pages, block_tables, cached_lens, new_lens, interpret=interpret
+        )
+    return paged_attention_ref(q, k_pages, v_pages, block_tables, cached_lens, new_lens)

@@ -1,0 +1,14 @@
+"""L1 serving: the in-tree TPU generation engine.
+
+Replaces the reference's out-of-tree vLLM deployment
+(helm/templates/qwen-deployment.yaml) with: a paged KV cache
+(serving/kv_cache.py), paged attention (ops/pallas_paged.py — Pallas TPU
+kernel with a gather-based fallback in ops/paged_attention.py), per-request
+sampling (ops/sampling.py), and a continuous-batching engine
+(serving/engine.py).  The OpenAI-compatible HTTP front end sits on top so
+every client in the system keeps speaking ``POST /v1/chat/completions``."""
+
+from githubrepostorag_tpu.serving.engine import Engine, GenerationResult
+from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+__all__ = ["Engine", "GenerationResult", "SamplingParams"]

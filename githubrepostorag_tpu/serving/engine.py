@@ -1,0 +1,439 @@
+"""The TPU generation engine: chunked prefill + batched decode over the paged
+KV cache, with continuous batching (new requests join the running batch at
+any step boundary, finished ones leave and their pages are recycled).
+
+This is the in-tree replacement for vLLM's scheduler+engine
+(helm/templates/qwen-deployment.yaml runs vllm-openai with
+``--max-num-seqs 4``; the MAX_NUM_SEQS env default is 64 per the v5e-8
+target in BASELINE.json config #5 — the constructor default stays small
+for tests, deployments pass Settings.max_num_seqs).
+
+Design notes (TPU-first):
+  - Every device computation has a fixed shape: decode is always
+    [max_num_seqs, 1]; prefill chunks are bucketed to powers of two, so XLA
+    compiles a handful of programs total, once.
+  - The page pools are donated through every step, so XLA performs KV
+    writes in place; block tables / slot mappings are tiny host-computed
+    int32 arrays shipped per step.
+  - Scheduling (which request prefills, who decodes, page allocation) is
+    host-side Python — control flow stays off the device; compute stays on.
+  - Sampling runs on-device with per-row parameters so one fused kernel
+    serves heterogeneous requests (greedy judge calls batched with
+    temperature-0.7 synthesis calls).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, forward_paged
+from githubrepostorag_tpu.ops.sampling import sample_tokens
+from githubrepostorag_tpu.serving.kv_cache import (
+    OutOfPages,
+    PageAllocator,
+    make_page_pools,
+    pages_needed,
+    slot_mapping,
+)
+from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TokenCallback = Callable[[str, int], None]  # (request_id, token_id)
+
+
+@dataclass
+class GenerationResult:
+    request_id: str
+    prompt_tokens: list[int]
+    output_tokens: list[int]
+    finish_reason: str  # "stop" | "length" | "cancelled" | "error"
+    ttft_s: float | None = None
+    decode_time_s: float = 0.0
+    error: str | None = None
+
+
+@dataclass
+class _Request:
+    request_id: str
+    prompt: list[int]
+    sampling: SamplingParams
+    on_token: TokenCallback | None
+    state: str = "waiting"  # waiting -> prefilling -> running -> done
+    row: int = -1  # seq slot in the batch
+    pages: list[int] = field(default_factory=list)
+    seq_len: int = 0  # tokens currently in the KV cache
+    prefill_pos: int = 0
+    output: list[int] = field(default_factory=list)
+    cancelled: bool = False
+    error: str | None = None
+    submit_t: float = field(default_factory=time.monotonic)
+    first_token_t: float | None = None
+
+
+def _bucket(n: int, cap: int) -> int:
+    b = 16
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class Engine:
+    def __init__(
+        self,
+        params: dict,
+        cfg: Qwen2Config,
+        *,
+        max_num_seqs: int = 8,
+        num_pages: int = 512,
+        page_size: int = 16,
+        max_seq_len: int = 2048,
+        prefill_chunk: int = 512,
+        kv_dtype=jnp.bfloat16,
+        use_pallas: bool = False,
+        rng_seed: int = 0,
+    ) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.max_num_seqs = max_num_seqs
+        self.page_size = page_size
+        self.max_seq_len = max_seq_len
+        self.max_pages_per_seq = pages_needed(max_seq_len, page_size)
+        self.prefill_chunk = prefill_chunk
+        self.use_pallas = use_pallas
+
+        pools = make_page_pools(cfg, num_pages, page_size, dtype=kv_dtype)
+        self._k_pages, self._v_pages = pools.k, pools.v
+        self._allocator = PageAllocator(num_pages)
+
+        # host-side batch state
+        self._block_tables = np.zeros((max_num_seqs, self.max_pages_per_seq), dtype=np.int32)
+        self._seq_lens = np.zeros((max_num_seqs,), dtype=np.int32)
+        self._free_rows = list(range(max_num_seqs - 1, -1, -1))
+        self._row_req: dict[int, _Request] = {}
+
+        # per-row sampling params (host mirror; pushed to device when dirty)
+        self._temp = np.full((max_num_seqs,), 1.0, dtype=np.float32)
+        self._top_p = np.ones((max_num_seqs,), dtype=np.float32)
+        self._top_k = np.zeros((max_num_seqs,), dtype=np.int32)
+        self._rep_pen = np.ones((max_num_seqs,), dtype=np.float32)
+        self._sampling_dirty = True
+        self._temp_d = self._top_p_d = self._top_k_d = self._rep_pen_d = None
+
+        # token-presence mask for repetition penalty [rows, V]
+        self._presence = jnp.zeros((max_num_seqs, cfg.vocab_size), dtype=bool)
+
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._waiting: list[_Request] = []
+        self._rejected: list[_Request] = []
+        self._requests: dict[str, _Request] = {}
+        self._ids = itertools.count()
+
+    # ------------------------------------------------------------- intake --
+
+    def add_request(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None = None,
+        on_token: TokenCallback | None = None,
+        request_id: str | None = None,
+    ) -> str:
+        rid = request_id or f"req-{next(self._ids)}"
+        sampling = sampling or SamplingParams()
+        req = _Request(request_id=rid, prompt=list(prompt_ids), sampling=sampling, on_token=on_token)
+        if len(req.prompt) + sampling.max_tokens > self.max_seq_len:
+            req.sampling = sampling.clamped(self.max_seq_len - len(req.prompt))
+        self._requests[rid] = req
+        error = None
+        if not req.prompt or len(req.prompt) >= self.max_seq_len:
+            error = "prompt empty or exceeds max_seq_len"
+        else:
+            need = pages_needed(
+                min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size
+            )
+            if need > self._allocator.num_pages:
+                error = (
+                    f"request needs {need} KV pages but the pool has only "
+                    f"{self._allocator.num_pages}; raise num_pages or shorten the request"
+                )
+        if error is not None:
+            # rejected at intake: surface through the next step() so streaming
+            # consumers driving add_request()/step() see a completion
+            req.state = "done"
+            req.error = error
+            self._rejected.append(req)
+            return rid
+        self._waiting.append(req)
+        return rid
+
+    def cancel(self, request_id: str) -> None:
+        req = self._requests.get(request_id)
+        if req is not None:
+            req.cancelled = True
+
+    def has_work(self) -> bool:
+        return bool(self._waiting or self._row_req or self._rejected)
+
+    @property
+    def num_running(self) -> int:
+        return len(self._row_req)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    # --------------------------------------------------------- scheduling --
+
+    def step(self) -> list[GenerationResult]:
+        """One engine iteration: admit + prefill one chunk if possible, else
+        decode every running row.  Returns requests finished this step."""
+        finished: list[GenerationResult] = []
+        for req in self._rejected:
+            res = self._result(req, "error")
+            res.error = req.error
+            finished.append(res)
+        self._rejected.clear()
+        self._reap_cancelled(finished)
+
+        did_prefill = self._try_prefill(finished)
+        if not did_prefill and self._row_req:
+            self._decode_step(finished)
+        return finished
+
+    def _reap_cancelled(self, finished: list[GenerationResult]) -> None:
+        for req in [r for r in self._waiting if r.cancelled]:
+            self._waiting.remove(req)
+            req.state = "done"
+            finished.append(self._result(req, "cancelled"))
+        for row, req in list(self._row_req.items()):
+            if req.cancelled:
+                self._release(req)
+                finished.append(self._result(req, "cancelled"))
+
+    def _try_prefill(self, finished: list[GenerationResult]) -> bool:
+        """Admit the next waiting request (or continue a partial prefill).
+        Returns True if a prefill chunk ran."""
+        # continue an in-flight chunked prefill first
+        for req in self._row_req.values():
+            if req.state == "prefilling":
+                self._prefill_chunk(req, finished)
+                return True
+        if not self._waiting or not self._free_rows:
+            return False
+        req = self._waiting[0]
+        need = pages_needed(min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size)
+        if need > self.max_pages_per_seq:
+            need = self.max_pages_per_seq
+        try:
+            pages = self._allocator.allocate(need)
+        except OutOfPages:
+            return False  # wait for running requests to finish
+        self._waiting.pop(0)
+        row = self._free_rows.pop()
+        req.row, req.pages, req.state = row, pages, "prefilling"
+        self._row_req[row] = req
+        self._block_tables[row, : len(pages)] = pages
+        self._seq_lens[row] = 0
+        self._set_row_sampling(row, req.sampling)
+        self._prefill_chunk(req, finished)
+        return True
+
+    # ------------------------------------------------------------ compute --
+
+    def _prefill_chunk(self, req: _Request, finished: list[GenerationResult]) -> None:
+        start = req.prefill_pos
+        remaining = len(req.prompt) - start
+        valid = min(remaining, self.prefill_chunk)
+        bucket = _bucket(valid, self.prefill_chunk)
+
+        ids = np.zeros((1, bucket), dtype=np.int32)
+        ids[0, :valid] = req.prompt[start : start + valid]
+        pos = np.zeros((1, bucket), dtype=np.int32)
+        pos[0] = np.arange(start, start + bucket)
+        slots = slot_mapping(self._block_tables[req.row], start, valid, self.page_size, bucket)[None, :]
+
+        # single-row views shaped for the batch-1 prefill program
+        bt = self._block_tables[req.row : req.row + 1]
+        cached = np.asarray([start], dtype=np.int32)
+        new_lens = np.asarray([valid], dtype=np.int32)
+
+        logits, self._k_pages, self._v_pages = forward_paged(
+            self.params, self.cfg,
+            jnp.asarray(ids), jnp.asarray(pos),
+            self._k_pages, self._v_pages,
+            jnp.asarray(slots), jnp.asarray(bt),
+            jnp.asarray(cached), jnp.asarray(new_lens),
+            use_pallas=self.use_pallas,
+        )
+
+        req.prefill_pos += valid
+        req.seq_len = req.prefill_pos
+        self._seq_lens[req.row] = req.seq_len
+
+        # mark prompt tokens in the presence mask (repetition penalty input)
+        chunk_ids = jnp.asarray(ids[0, :valid])
+        self._presence = _mark_presence(self._presence, req.row, chunk_ids)
+
+        if req.prefill_pos < len(req.prompt):
+            return  # more chunks to go
+
+        # prompt fully cached: sample the first token from the last position
+        req.state = "running"
+        last_logits = logits[:, valid - 1]  # [1, V]
+        token = self._sample_rows(last_logits, np.asarray([req.row]))[0]
+        self._commit_token(req, int(token), finished)
+
+    def _decode_step(self, finished: list[GenerationResult]) -> None:
+        rows = sorted(self._row_req)
+        b = self.max_num_seqs
+
+        ids = np.zeros((b, 1), dtype=np.int32)
+        pos = np.zeros((b, 1), dtype=np.int32)
+        slots = np.full((b, 1), -1, dtype=np.int32)
+        new_lens = np.zeros((b,), dtype=np.int32)
+        for row in rows:
+            req = self._row_req[row]
+            last = req.output[-1] if req.output else req.prompt[-1]
+            ids[row, 0] = last
+            pos[row, 0] = req.seq_len
+            slots[row, 0] = slot_mapping(
+                self._block_tables[row], req.seq_len, 1, self.page_size, 1
+            )[0]
+            new_lens[row] = 1
+
+        logits, self._k_pages, self._v_pages = forward_paged(
+            self.params, self.cfg,
+            jnp.asarray(ids), jnp.asarray(pos),
+            self._k_pages, self._v_pages,
+            jnp.asarray(slots), jnp.asarray(self._block_tables),
+            jnp.asarray(self._seq_lens), jnp.asarray(new_lens),
+            use_pallas=self.use_pallas,
+        )
+
+        tokens = self._sample_rows(logits[:, 0], np.asarray(rows, dtype=np.int32), full_batch=True)
+        for row in rows:
+            req = self._row_req[row]
+            req.seq_len += 1
+            self._seq_lens[row] = req.seq_len
+            self._commit_token(req, int(tokens[row]), finished)
+
+    def _sample_rows(self, logits: jnp.ndarray, rows: np.ndarray, full_batch: bool = False) -> np.ndarray:
+        """Sample tokens for the given rows.  ``logits`` is [len(rows), V]
+        (or [max_num_seqs, V] when full_batch)."""
+        if self._sampling_dirty:
+            self._temp_d = jnp.asarray(self._temp)
+            self._top_p_d = jnp.asarray(self._top_p)
+            self._top_k_d = jnp.asarray(self._top_k)
+            self._rep_pen_d = jnp.asarray(self._rep_pen)
+            self._sampling_dirty = False
+        self._rng, key = jax.random.split(self._rng)
+        if full_batch:
+            toks = sample_tokens(
+                logits, key, self._temp_d, self._top_p_d, self._top_k_d,
+                self._rep_pen_d, self._presence
+            )
+            self._presence = _mark_presence_rows(self._presence, jnp.asarray(rows), toks[jnp.asarray(rows)])
+            return np.asarray(toks)
+        row_idx = jnp.asarray(rows)
+        toks = sample_tokens(
+            logits, key,
+            self._temp_d[row_idx], self._top_p_d[row_idx], self._top_k_d[row_idx],
+            self._rep_pen_d[row_idx],
+            self._presence[row_idx],
+        )
+        self._presence = _mark_presence_rows(self._presence, row_idx, toks)
+        return np.asarray(toks)
+
+    # ---------------------------------------------------------- lifecycle --
+
+    def _commit_token(self, req: _Request, token: int, finished: list[GenerationResult]) -> None:
+        if req.first_token_t is None:
+            req.first_token_t = time.monotonic()
+        req.output.append(token)
+        if req.on_token is not None:
+            try:
+                req.on_token(req.request_id, token)
+            except Exception:  # noqa: BLE001 - callbacks must not kill the engine
+                logger.exception("on_token callback failed for %s", req.request_id)
+        stop_ids = req.sampling.stop_token_ids
+        if token in stop_ids:
+            self._release(req)
+            finished.append(self._result(req, "stop"))
+        elif len(req.output) >= req.sampling.max_tokens or req.seq_len + 1 >= self.max_seq_len:
+            self._release(req)
+            finished.append(self._result(req, "length"))
+
+    def _release(self, req: _Request) -> None:
+        if req.row >= 0:
+            self._allocator.release(req.pages)
+            self._row_req.pop(req.row, None)
+            self._free_rows.append(req.row)
+            self._seq_lens[req.row] = 0
+            self._block_tables[req.row] = 0
+            req.row = -1
+        req.state = "done"
+
+    def _set_row_sampling(self, row: int, sp: SamplingParams) -> None:
+        self._temp[row] = sp.temperature
+        self._top_p[row] = sp.top_p
+        self._top_k[row] = sp.top_k
+        self._rep_pen[row] = sp.repetition_penalty
+        self._sampling_dirty = True
+        # fresh presence row for the new occupant
+        self._presence = _clear_presence_row(self._presence, row)
+
+    def _result(self, req: _Request, reason: str) -> GenerationResult:
+        ttft = (req.first_token_t - req.submit_t) if req.first_token_t else None
+        return GenerationResult(
+            request_id=req.request_id,
+            prompt_tokens=req.prompt,
+            output_tokens=req.output,
+            finish_reason=reason,
+            ttft_s=ttft,
+            decode_time_s=(time.monotonic() - req.first_token_t) if req.first_token_t else 0.0,
+        )
+
+    # --------------------------------------------------------- convenience --
+
+    def generate(
+        self,
+        prompts: list[list[int]],
+        sampling: SamplingParams | list[SamplingParams] | None = None,
+    ) -> list[GenerationResult]:
+        """Synchronous batch generation (tests, ingest extractors, bench)."""
+        if isinstance(sampling, list):
+            sps = sampling
+        else:
+            sps = [sampling or SamplingParams()] * len(prompts)
+        order = [self.add_request(p, sp) for p, sp in zip(prompts, sps)]
+        done: dict[str, GenerationResult] = {}
+        while self.has_work():
+            for res in self.step():
+                done[res.request_id] = res
+        return [done[rid] for rid in order]
+
+
+# ---- small jitted presence-mask helpers ----------------------------------
+
+
+@jax.jit
+def _mark_presence(presence: jnp.ndarray, row: int, token_ids: jnp.ndarray) -> jnp.ndarray:
+    return presence.at[row, token_ids].set(True, mode="drop")
+
+
+@jax.jit
+def _mark_presence_rows(presence: jnp.ndarray, rows: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    return presence.at[rows, tokens].set(True, mode="drop")
+
+
+@jax.jit
+def _clear_presence_row(presence: jnp.ndarray, row: int) -> jnp.ndarray:
+    return presence.at[row].set(False)

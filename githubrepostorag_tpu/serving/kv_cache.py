@@ -1,0 +1,91 @@
+"""Paged KV cache: device-side page pools + host-side page allocator.
+
+The vLLM idea (PagedAttention) rebuilt for TPU/XLA: K/V live in fixed page
+pools ``[L, num_pages, page_size, n_kv, hd]`` so sequences grow without
+reallocation or copy; a sequence's pages are an indirection table
+(``block_table``).  Writes are flat scatters with out-of-bounds drop
+semantics (padding tokens get slot -1), which XLA lowers to an efficient
+in-place scatter when the pools are donated into the step function.
+
+Host side, the ``PageAllocator`` is plain Python — allocation decisions are
+control flow, not compute, and belong off-device (SURVEY.md §7 stage 2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config
+
+
+@dataclass
+class PagePools:
+    """Device arrays holding every sequence's K/V pages for all layers.
+
+    Layout [L, n_kv, P, page_size, hd] keeps each page's (page_size, hd)
+    slab contiguous in the trailing two axes — the natural (sublane, lane)
+    tile for the Pallas kernel's page DMAs — and lets the KV scatter index a
+    flat [n_kv, P*page_size, hd] view with one slot vector shared by all
+    heads."""
+
+    k: jnp.ndarray  # [L, n_kv, P, page_size, hd]
+    v: jnp.ndarray
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
+def make_page_pools(
+    cfg: Qwen2Config, num_pages: int, page_size: int, dtype=jnp.bfloat16
+) -> PagePools:
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
+    return PagePools(k=jnp.zeros(shape, dtype=dtype), v=jnp.zeros(shape, dtype=dtype))
+
+
+class OutOfPages(RuntimeError):
+    """Raised when the pool can't back a new allocation; the scheduler
+    responds by queueing (or preempting) instead of corrupting the cache."""
+
+
+class PageAllocator:
+    """Free-list allocator over the page pool."""
+
+    def __init__(self, num_pages: int) -> None:
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        self.num_pages = num_pages
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise OutOfPages(f"need {n} pages, {len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def release(self, pages: list[int]) -> None:
+        self._free.extend(pages)
+
+
+def pages_needed(num_tokens: int, page_size: int) -> int:
+    return -(-num_tokens // page_size)
+
+
+def slot_mapping(
+    block_table_row: np.ndarray, start_pos: int, num_tokens: int, page_size: int, pad_to: int
+) -> np.ndarray:
+    """Flat pool slots for tokens [start_pos, start_pos + num_tokens), padded
+    with -1 (out-of-bounds -> scatter drops the write)."""
+    positions = np.arange(start_pos, start_pos + num_tokens)
+    slots = block_table_row[positions // page_size] * page_size + positions % page_size
+    out = np.full((pad_to,), -1, dtype=np.int32)
+    out[:num_tokens] = slots
+    return out

@@ -1,0 +1,210 @@
+"""Generation engine: paged prefill/decode vs HF generate, continuous
+batching, streaming callbacks, cancellation, page accounting."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.serving import Engine, SamplingParams
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from githubrepostorag_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg.to_dict())
+    params = params_from_state_dict(model.state_dict(), cfg)
+    return model, params, cfg
+
+
+def _make_engine(params, cfg, **kw):
+    defaults = dict(
+        max_num_seqs=4, num_pages=64, page_size=8, max_seq_len=128,
+        prefill_chunk=32, kv_dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return Engine(params, cfg, **defaults)
+
+
+def _hf_greedy(model, prompt, n):
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        out = model.generate(
+            ids, max_new_tokens=n, do_sample=False,
+            pad_token_id=0, eos_token_id=None, use_cache=True,
+        )
+    return out[0, len(prompt):].tolist()
+
+
+def test_greedy_matches_hf(tiny):
+    model, params, cfg = tiny
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, size=23).tolist()
+    eng = _make_engine(params, cfg)
+    res = eng.generate([prompt], SamplingParams(temperature=0.0, max_tokens=10))[0]
+    assert res.finish_reason == "length"
+    assert res.output_tokens == _hf_greedy(model, prompt, 10)
+
+
+def test_concurrent_requests_match_individual(tiny):
+    model, params, cfg = tiny
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, cfg.vocab_size, size=n).tolist() for n in (5, 17, 33)]
+    eng = _make_engine(params, cfg)
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    results = eng.generate(prompts, sp)
+    for prompt, res in zip(prompts, results):
+        assert res.output_tokens == _hf_greedy(model, prompt, 8), "batched != individual"
+
+
+def test_chunked_prefill_long_prompt(tiny):
+    model, params, cfg = tiny
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, cfg.vocab_size, size=70).tolist()  # > prefill_chunk=32
+    eng = _make_engine(params, cfg)
+    res = eng.generate([prompt], SamplingParams(temperature=0.0, max_tokens=5))[0]
+    assert res.output_tokens == _hf_greedy(model, prompt, 5)
+
+
+def test_streaming_callback_order(tiny):
+    _, params, cfg = tiny
+    eng = _make_engine(params, cfg)
+    seen: list[tuple[str, int]] = []
+    rid = eng.add_request(
+        [1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=6),
+        on_token=lambda r, t: seen.append((r, t)),
+    )
+    done = []
+    while eng.has_work():
+        done.extend(eng.step())
+    assert [t for _, t in seen] == done[0].output_tokens
+    assert all(r == rid for r, _ in seen)
+
+
+def test_stop_token_ends_generation(tiny):
+    model, params, cfg = tiny
+    prompt = [7, 8, 9, 10, 11]
+    first = _hf_greedy(model, prompt, 1)[0]
+    eng = _make_engine(params, cfg)
+    res = eng.generate([prompt], SamplingParams(temperature=0.0, max_tokens=20, stop_token_ids=(first,)))[0]
+    assert res.finish_reason == "stop"
+    assert res.output_tokens == [first]
+
+
+def test_cancellation(tiny):
+    _, params, cfg = tiny
+    eng = _make_engine(params, cfg)
+    rid = eng.add_request([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=50))
+    eng.step()  # prefill + first token
+    eng.cancel(rid)
+    done = []
+    while eng.has_work():
+        done.extend(eng.step())
+    assert done[0].finish_reason == "cancelled"
+    assert eng._allocator.free_count == eng._allocator.num_pages  # pages recycled
+
+
+def test_pages_exhaustion_queues_requests(tiny):
+    _, params, cfg = tiny
+    # only 8 pages of 8 tokens: two 20+16-token requests can't both fit
+    eng = _make_engine(params, cfg, num_pages=8, max_seq_len=64)
+    sp = SamplingParams(temperature=0.0, max_tokens=16)
+    prompts = [[1] * 20, [2] * 20, [3] * 20]
+    results = eng.generate(prompts, sp)
+    assert all(r.finish_reason == "length" for r in results)
+    assert all(len(r.output_tokens) == 16 for r in results)
+    assert eng._allocator.free_count == eng._allocator.num_pages
+
+
+def test_sampled_generation_respects_seed_and_temperature(tiny):
+    _, params, cfg = tiny
+    prompt = list(range(1, 12))
+    sp = SamplingParams(temperature=0.8, top_p=0.95, max_tokens=12)
+    r1 = _make_engine(params, cfg, rng_seed=7).generate([prompt], sp)[0]
+    r2 = _make_engine(params, cfg, rng_seed=7).generate([prompt], sp)[0]
+    r3 = _make_engine(params, cfg, rng_seed=8).generate([prompt], sp)[0]
+    assert r1.output_tokens == r2.output_tokens  # deterministic per seed
+    assert len(r3.output_tokens) == 12
+
+
+def test_repetition_penalty_discourages_repeats(tiny):
+    _, params, cfg = tiny
+    prompt = [5] * 10
+    base = _make_engine(params, cfg).generate(
+        [prompt], SamplingParams(temperature=0.0, max_tokens=16, repetition_penalty=1.0)
+    )[0]
+    pen = _make_engine(params, cfg).generate(
+        [prompt], SamplingParams(temperature=0.0, max_tokens=16, repetition_penalty=1.8)
+    )[0]
+    assert len(set(pen.output_tokens)) >= len(set(base.output_tokens))
+
+
+def test_last_page_not_corrupted_by_padding_slots(tiny):
+    """Regression: JAX scatter wraps negative indices, so the -1 padding
+    slots of inactive rows must not overwrite the last pool slot while a
+    live sequence occupies the last page."""
+    model, params, cfg = tiny
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, cfg.vocab_size, size=20).tolist()
+    # exactly 3 pages of 8 -> the sequence owns the LAST page of the pool,
+    # and 3 of the 4 batch rows are inactive (slot -1) every decode step
+    eng = _make_engine(params, cfg, num_pages=3, page_size=8, max_seq_len=24, max_num_seqs=4)
+    res = eng.generate([prompt], SamplingParams(temperature=0.0, max_tokens=4))[0]
+    assert res.output_tokens == _hf_greedy(model, prompt, 4)
+
+
+def test_bad_prompt_reports_error(tiny):
+    _, params, cfg = tiny
+    eng = _make_engine(params, cfg)
+    res = eng.generate([[]], SamplingParams(max_tokens=4))[0]
+    assert res.finish_reason == "error"
+    assert "prompt" in res.error
+
+
+def test_request_larger_than_pool_rejected_not_livelocked(tiny):
+    """Regression: a request needing more pages than the whole pool must be
+    rejected at intake, not spin the engine forever."""
+    _, params, cfg = tiny
+    eng = _make_engine(params, cfg, num_pages=4, page_size=8, max_seq_len=128)
+    res = eng.generate(
+        [[1] * 50, [2] * 10],
+        [SamplingParams(temperature=0.0, max_tokens=30), SamplingParams(temperature=0.0, max_tokens=4)],
+    )
+    assert res[0].finish_reason == "error"
+    assert "pages" in res[0].error
+    assert res[1].finish_reason == "length"  # queue not head-of-line blocked
+
+
+def test_rejected_request_surfaces_through_step(tiny):
+    _, params, cfg = tiny
+    eng = _make_engine(params, cfg)
+    rid = eng.add_request([], SamplingParams(max_tokens=4))
+    assert eng.has_work()
+    finished = eng.step()
+    assert [r.request_id for r in finished] == [rid]
+    assert finished[0].finish_reason == "error"
+
+
+def test_top_k_sampling(tiny):
+    _, params, cfg = tiny
+    prompt = list(range(1, 10))
+    greedy = _make_engine(params, cfg).generate(
+        [prompt], SamplingParams(temperature=0.0, max_tokens=6)
+    )[0]
+    k1 = _make_engine(params, cfg).generate(
+        [prompt], SamplingParams(temperature=5.0, top_k=1, max_tokens=6)
+    )[0]
+    # top_k=1 at any temperature collapses to greedy
+    assert k1.output_tokens == greedy.output_tokens
