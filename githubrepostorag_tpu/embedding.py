@@ -1,0 +1,182 @@
+"""Text embedding service: the one encoder shared by ingest writes and
+query-time retrieval (the reference instantiates four separate
+HuggingFaceEmbeddings copies — graph_rag_retrievers.py:53,
+vector_write_service.py:117, ingest_controller.py:376,
+cassandra_service.py:127; here there is one service with two call shapes).
+
+Two encoder backends behind one protocol:
+  - ``JaxBertTextEncoder`` — the real path: HF tokenizer + the in-tree JAX
+    BERT encoder (models/encoder.py), length-bucketed batches on TPU.
+    e5-style ``query:``/``passage:`` prefixes applied when the model name
+    says e5 (the reference's documented model is intfloat/e5-small-v2).
+  - ``HashingTextEncoder`` — deterministic, dependency-free 384-d encoder
+    (signed feature hashing of word/bigram tokens, L2-normalized).  The
+    test backbone and the no-weights dev backend; cosine similarity tracks
+    lexical overlap so retrieval behaves sensibly end-to-end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Literal, Protocol, Sequence
+
+import numpy as np
+
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.utils import next_bucket
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+Kind = Literal["query", "passage"]
+
+
+class TextEncoder(Protocol):
+    dim: int
+
+    def encode(self, texts: Sequence[str], kind: Kind = "passage") -> np.ndarray:
+        """-> [N, dim] float32, L2-normalized rows."""
+        ...
+
+
+_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]+|[0-9]+")
+
+
+class HashingTextEncoder:
+    """Signed feature hashing over words + bigrams, sublinear tf, L2 norm."""
+
+    def __init__(self, dim: int | None = None) -> None:
+        self.dim = dim or get_settings().embed_dim
+
+    def _tokens(self, text: str) -> list[str]:
+        words = [w.lower() for w in _WORD_RE.findall(text)]
+        bigrams = [f"{a}_{b}" for a, b in zip(words, words[1:])]
+        return words + bigrams
+
+    def encode(self, texts: Sequence[str], kind: Kind = "passage") -> np.ndarray:
+        out = np.zeros((len(texts), self.dim), dtype=np.float32)
+        for i, text in enumerate(texts):
+            counts: dict[str, int] = {}
+            for tok in self._tokens(text):
+                counts[tok] = counts.get(tok, 0) + 1
+            for tok, count in counts.items():
+                digest = hashlib.md5(tok.encode("utf-8")).digest()
+                idx = int.from_bytes(digest[:4], "little") % self.dim
+                sign = 1.0 if digest[4] & 1 else -1.0
+                out[i, idx] += sign * (1.0 + np.log(count))
+            norm = np.linalg.norm(out[i])
+            if norm > 0:
+                out[i] /= norm
+        return out
+
+
+class JaxBertTextEncoder:
+    """HF tokenizer + in-tree JAX BERT.  Batches are length-bucketed so XLA
+    compiles a handful of shapes; big ingest batches saturate the MXU."""
+
+    def __init__(
+        self,
+        params: dict,
+        cfg,
+        tokenizer,
+        *,
+        max_length: int = 512,
+        batch_size: int = 64,
+        e5_prefixes: bool = True,
+    ) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.e5_prefixes = e5_prefixes
+        self.dim = cfg.hidden_size
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str, **kw) -> "JaxBertTextEncoder":
+        import json
+        from pathlib import Path
+
+        from transformers import AutoTokenizer
+
+        from githubrepostorag_tpu.models.encoder import BertConfig, params_from_hf_state_dict
+
+        root = Path(model_dir)
+        hf_cfg = json.loads((root / "config.json").read_text())
+        cfg = BertConfig(
+            vocab_size=hf_cfg["vocab_size"],
+            hidden_size=hf_cfg["hidden_size"],
+            intermediate_size=hf_cfg["intermediate_size"],
+            num_layers=hf_cfg["num_hidden_layers"],
+            num_heads=hf_cfg["num_attention_heads"],
+            max_position_embeddings=hf_cfg["max_position_embeddings"],
+            type_vocab_size=hf_cfg.get("type_vocab_size", 2),
+            layer_norm_eps=hf_cfg.get("layer_norm_eps", 1e-12),
+        )
+        state: dict = {}
+        from safetensors import safe_open
+
+        for shard in sorted(root.glob("*.safetensors")):
+            with safe_open(str(shard), framework="np") as f:
+                for key in f.keys():
+                    state[key] = f.get_tensor(key)
+        params = params_from_hf_state_dict(state, cfg)
+        tokenizer = AutoTokenizer.from_pretrained(model_dir)
+        kw.setdefault("e5_prefixes", "e5" in model_dir.lower())
+        return cls(params, cfg, tokenizer, **kw)
+
+    def encode(self, texts: Sequence[str], kind: Kind = "passage") -> np.ndarray:
+        import jax.numpy as jnp
+
+        from githubrepostorag_tpu.models.encoder import embed
+
+        if self.e5_prefixes:
+            prefix = "query: " if kind == "query" else "passage: "
+            texts = [prefix + t for t in texts]
+
+        out = np.zeros((len(texts), self.dim), dtype=np.float32)
+        order = sorted(range(len(texts)), key=lambda i: len(texts[i]))
+        for start in range(0, len(order), self.batch_size):
+            idx = order[start : start + self.batch_size]
+            enc = self.tokenizer(
+                [texts[i] for i in idx],
+                truncation=True,
+                max_length=self.max_length,
+                padding=False,
+            )
+            max_len = max(len(x) for x in enc["input_ids"])
+            bucket = next_bucket(max_len, self.max_length)
+            ids = np.zeros((len(idx), bucket), dtype=np.int32)
+            mask = np.zeros((len(idx), bucket), dtype=np.int32)
+            for row, toks in enumerate(enc["input_ids"]):
+                ids[row, : len(toks)] = toks
+                mask[row, : len(toks)] = 1
+            vecs = embed(self.params, self.cfg, jnp.asarray(ids), jnp.asarray(mask))
+            out[idx] = np.asarray(vecs)
+        return out
+
+
+_encoder: TextEncoder | None = None
+
+
+def get_encoder() -> TextEncoder:
+    """Process-wide encoder: JAX BERT when EMBED_MODEL points at a local
+    checkpoint dir, else the hashing fallback."""
+    global _encoder
+    if _encoder is None:
+        import os
+
+        model = get_settings().embed_model
+        if model and os.path.isdir(model):
+            _encoder = JaxBertTextEncoder.from_pretrained(model)
+            logger.info("embedding: JAX BERT encoder from %s", model)
+        else:
+            _encoder = HashingTextEncoder()
+            logger.info("embedding: hashing fallback encoder (no local checkpoint at %r)", model)
+    return _encoder
+
+
+def set_encoder(encoder: TextEncoder | None) -> None:
+    global _encoder
+    _encoder = encoder

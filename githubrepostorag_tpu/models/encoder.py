@@ -1,0 +1,182 @@
+"""BERT-class text encoder in pure functional JAX (e5-small-v2 geometry:
+12 layers, hidden 384, 12 heads, GELU FFN 1536, learned positions,
+post-layer-norm blocks, mean pooling + L2 normalization).
+
+Replaces the reference's CPU-torch ``HuggingFaceEmbeddings`` encoder
+(instantiated at graph_rag_retrievers.py:53, vector_write_service.py:117,
+ingest_controller.py:376, cassandra_service.py:127 — all torch 2.3 CPU per
+environment-worker.yaml:9) with a TPU path: big batches ride the MXU during
+ingest (pjit data-parallel over the mesh), single queries take a small
+padded bucket for low latency at retrieval time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    intermediate_size: int = 1536
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @classmethod
+    def e5_small(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(
+            vocab_size=256, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=4, max_position_embeddings=64,
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _layer_norm(x, weight, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight + bias
+
+
+def init_params(cfg: BertConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    d, inter, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    keys = jax.random.split(key, 12)
+
+    def norm(k, *shape):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * 0.02).astype(dtype)
+
+    layers = {
+        "wq": norm(keys[0], L, d, d), "bq": jnp.zeros((L, d), dtype),
+        "wk": norm(keys[1], L, d, d), "bk": jnp.zeros((L, d), dtype),
+        "wv": norm(keys[2], L, d, d), "bv": jnp.zeros((L, d), dtype),
+        "wo": norm(keys[3], L, d, d), "bo": jnp.zeros((L, d), dtype),
+        "ln_attn_w": jnp.ones((L, d), dtype), "ln_attn_b": jnp.zeros((L, d), dtype),
+        "w1": norm(keys[4], L, d, inter), "b1": jnp.zeros((L, inter), dtype),
+        "w2": norm(keys[5], L, inter, d), "b2": jnp.zeros((L, d), dtype),
+        "ln_ffn_w": jnp.ones((L, d), dtype), "ln_ffn_b": jnp.zeros((L, d), dtype),
+    }
+    return {
+        "word_embeddings": norm(keys[6], cfg.vocab_size, d),
+        "position_embeddings": norm(keys[7], cfg.max_position_embeddings, d),
+        "token_type_embeddings": norm(keys[8], cfg.type_vocab_size, d),
+        "ln_embed_w": jnp.ones((d,), dtype),
+        "ln_embed_b": jnp.zeros((d,), dtype),
+        "layers": layers,
+    }
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward(
+    params: dict,
+    cfg: BertConfig,
+    input_ids: jnp.ndarray,  # [B, S] int32
+    attention_mask: jnp.ndarray,  # [B, S] 1 = real token
+) -> jnp.ndarray:
+    """Token-level hidden states [B, S, D]."""
+    b, s = input_ids.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    pos_ids = jnp.arange(s)[None, :]
+    h = (
+        jnp.take(params["word_embeddings"], input_ids, axis=0)
+        + params["position_embeddings"][pos_ids]
+        + params["token_type_embeddings"][0][None, None, :]
+    )
+    h = _layer_norm(h, params["ln_embed_w"], params["ln_embed_b"], cfg.layer_norm_eps)
+
+    # additive mask [B, 1, 1, S]
+    neg = jnp.asarray(-1e30, h.dtype)
+    attn_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+
+    def body(h, p):
+        q = (h @ p["wq"] + p["bq"]).reshape(b, s, nh, hd)
+        k = (h @ p["wk"] + p["bk"]).reshape(b, s, nh, hd)
+        v = (h @ p["wv"] + p["bv"]).reshape(b, s, nh, hd)
+        scores = jnp.einsum("bsnh,btnh->bnst", q, k).astype(jnp.float32)
+        scores = scores / (hd ** 0.5) + attn_bias.astype(jnp.float32)
+        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bnst,btnh->bsnh", probs, v).reshape(b, s, nh * hd)
+        attn_out = ctx @ p["wo"] + p["bo"]
+        h = _layer_norm(h + attn_out, p["ln_attn_w"], p["ln_attn_b"], cfg.layer_norm_eps)
+        ffn = jax.nn.gelu(h @ p["w1"] + p["b1"], approximate=False) @ p["w2"] + p["b2"]
+        h = _layer_norm(h + ffn, p["ln_ffn_w"], p["ln_ffn_b"], cfg.layer_norm_eps)
+        return h, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    return h
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def embed(
+    params: dict,
+    cfg: BertConfig,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Sentence embeddings: masked mean pooling + L2 norm -> [B, D] float32
+    (the e5 family's pooling; sentence-transformers' default mean pooling)."""
+    h = forward(params, cfg, input_ids, attention_mask).astype(jnp.float32)
+    mask = attention_mask[..., None].astype(jnp.float32)
+    pooled = (h * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1e-9)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+
+
+def params_from_hf_state_dict(state_dict: dict, cfg: BertConfig, dtype=np.float32) -> dict:
+    """Convert a HF BertModel state dict (bert.* or bare) to our pytree."""
+
+    def _np(t):
+        if isinstance(t, np.ndarray):
+            return t
+        return t.detach().to("cpu").float().numpy()
+
+    sd = {}
+    for k, v in state_dict.items():
+        sd[k.removeprefix("bert.")] = v
+    L = cfg.num_layers
+
+    def get(name):
+        return _np(sd[name])
+
+    def lin(fmt):  # HF [out, in] -> [in, out], stacked
+        return np.stack([get(fmt.format(i)).T for i in range(L)]).astype(dtype)
+
+    def vec(fmt):
+        return np.stack([get(fmt.format(i)) for i in range(L)]).astype(dtype)
+
+    pre = "encoder.layer.{}."
+    layers = {
+        "wq": lin(pre + "attention.self.query.weight"), "bq": vec(pre + "attention.self.query.bias"),
+        "wk": lin(pre + "attention.self.key.weight"), "bk": vec(pre + "attention.self.key.bias"),
+        "wv": lin(pre + "attention.self.value.weight"), "bv": vec(pre + "attention.self.value.bias"),
+        "wo": lin(pre + "attention.output.dense.weight"), "bo": vec(pre + "attention.output.dense.bias"),
+        "ln_attn_w": vec(pre + "attention.output.LayerNorm.weight"),
+        "ln_attn_b": vec(pre + "attention.output.LayerNorm.bias"),
+        "w1": lin(pre + "intermediate.dense.weight"), "b1": vec(pre + "intermediate.dense.bias"),
+        "w2": lin(pre + "output.dense.weight"), "b2": vec(pre + "output.dense.bias"),
+        "ln_ffn_w": vec(pre + "output.LayerNorm.weight"),
+        "ln_ffn_b": vec(pre + "output.LayerNorm.bias"),
+    }
+    return {
+        "word_embeddings": get("embeddings.word_embeddings.weight").astype(dtype),
+        "position_embeddings": get("embeddings.position_embeddings.weight").astype(dtype),
+        "token_type_embeddings": get("embeddings.token_type_embeddings.weight").astype(dtype),
+        "ln_embed_w": get("embeddings.LayerNorm.weight").astype(dtype),
+        "ln_embed_b": get("embeddings.LayerNorm.bias").astype(dtype),
+        "layers": layers,
+    }

@@ -205,17 +205,20 @@ def forward(
 
     h, cache_out = jax.lax.scan(body, h, xs)
     h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
-
-    lm_head = params.get("lm_head")
-    if lm_head is None:
-        logits = h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    else:
-        logits = h.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+    logits = _logits(params, h)
 
     if use_cache:
         new_k, new_v = cache_out
         return logits, (new_k, new_v)
     return logits, None
+
+
+def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
+    """Final projection in float32 (tied embedding or separate lm_head)."""
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        return h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return h.astype(jnp.float32) @ lm_head.astype(jnp.float32)
 
 
 def make_dense_cache(cfg: Qwen2Config, batch: int, max_len: int, dtype=jnp.bfloat16):
@@ -285,10 +288,4 @@ def forward_paged(
 
     h, (k_pages, v_pages) = jax.lax.scan(body, h, (params["layers"], k_pages, v_pages))
     h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
-
-    lm_head = params.get("lm_head")
-    if lm_head is None:
-        logits = h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    else:
-        logits = h.astype(jnp.float32) @ lm_head.astype(jnp.float32)
-    return logits, k_pages, v_pages
+    return _logits(params, h), k_pages, v_pages

@@ -48,8 +48,11 @@ def top_p_mask(logits: jnp.ndarray, p: jnp.ndarray | float) -> jnp.ndarray:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cumprobs = jnp.cumsum(probs, axis=-1)
-    # keep tokens while cumulative prob of *previous* tokens < p
+    # keep tokens while cumulative prob of *previous* tokens < p; the top
+    # token always survives (p <= 0 must degrade to near-greedy, not to
+    # uniform sampling over a fully masked vocab)
     keep_sorted = (cumprobs - probs) < jnp.asarray(p)[..., None]
+    keep_sorted = keep_sorted.at[..., 0].set(True)
     # threshold = smallest kept logit
     threshold = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
     return jnp.where(logits < threshold, NEG_INF, logits)

@@ -79,11 +79,7 @@ class _Request:
     first_token_t: float | None = None
 
 
-def _bucket(n: int, cap: int) -> int:
-    b = 16
-    while b < n:
-        b *= 2
-    return min(b, cap)
+from githubrepostorag_tpu.utils import next_bucket as _bucket
 
 
 class Engine:
@@ -230,8 +226,7 @@ class Engine:
             return False
         req = self._waiting[0]
         need = pages_needed(min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size)
-        if need > self.max_pages_per_seq:
-            need = self.max_pages_per_seq
+        assert need <= self.max_pages_per_seq, "intake clamp must bound the page need"
         try:
             pages = self._allocator.allocate(need)
         except OutOfPages:
@@ -391,6 +386,9 @@ class Engine:
         self._presence = _clear_presence_row(self._presence, row)
 
     def _result(self, req: _Request, reason: str) -> GenerationResult:
+        # the request is finished; drop the engine's reference so a
+        # long-running server doesn't accumulate every prompt ever served
+        self._requests.pop(req.request_id, None)
         ttft = (req.first_token_t - req.submit_t) if req.first_token_t else None
         return GenerationResult(
             request_id=req.request_id,

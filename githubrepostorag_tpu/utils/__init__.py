@@ -1,4 +1,15 @@
 from githubrepostorag_tpu.utils.json_utils import extract_json, extract_choice
 from githubrepostorag_tpu.utils.logging import get_logger
 
-__all__ = ["extract_json", "extract_choice", "get_logger"]
+
+def next_bucket(n: int, cap: int, minimum: int = 16) -> int:
+    """Smallest power-of-two >= n (floored at ``minimum``, capped at ``cap``).
+    Shared by every path that pads dynamic lengths into a handful of XLA
+    compilation shapes (prefill chunks, encoder batches)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+__all__ = ["extract_json", "extract_choice", "get_logger", "next_bucket"]

@@ -1,0 +1,111 @@
+"""BERT encoder parity vs transformers and embedding-service behavior."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.embedding import HashingTextEncoder, JaxBertTextEncoder
+from githubrepostorag_tpu.models.encoder import (
+    BertConfig,
+    embed,
+    forward,
+    init_params,
+    params_from_hf_state_dict,
+)
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_bert():
+    hf_cfg = transformers.BertConfig(
+        vocab_size=256, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.BertModel(hf_cfg).eval()
+    cfg = BertConfig.tiny()
+    params = params_from_hf_state_dict(model.state_dict(), cfg)
+    return model, params, cfg
+
+
+def test_hidden_states_match_hf(tiny_bert):
+    model, params, cfg = tiny_bert
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=(2, 11))
+    mask = np.ones((2, 11), dtype=np.int64)
+    mask[1, 7:] = 0  # padded row
+    with torch.no_grad():
+        ref = model(torch.tensor(ids), attention_mask=torch.tensor(mask)).last_hidden_state.numpy()
+    ours = forward(params, cfg, jnp.asarray(ids, jnp.int32), jnp.asarray(mask, jnp.int32))
+    # padded positions may differ; compare only valid tokens
+    np.testing.assert_allclose(np.asarray(ours)[0], ref[0], atol=2e-4, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(ours)[1, :7], ref[1, :7], atol=2e-4, rtol=2e-3)
+
+
+def test_embed_is_masked_mean_pool_normalized(tiny_bert):
+    _, params, cfg = tiny_bert
+    ids = jnp.asarray([[5, 6, 7, 0, 0]], jnp.int32)
+    mask = jnp.asarray([[1, 1, 1, 0, 0]], jnp.int32)
+    vec = embed(params, cfg, ids, mask)
+    assert vec.shape == (1, cfg.hidden_size)
+    assert np.linalg.norm(np.asarray(vec)[0]) == pytest.approx(1.0, abs=1e-5)
+    # padding must not affect the embedding
+    ids2 = jnp.asarray([[5, 6, 7, 9, 9]], jnp.int32)
+    vec2 = embed(params, cfg, ids2, mask)
+    np.testing.assert_allclose(np.asarray(vec), np.asarray(vec2), atol=1e-5)
+
+
+def test_jax_text_encoder_batching(tiny_bert):
+    _, params, cfg = tiny_bert
+
+    class StubTokenizer:
+        def __call__(self, texts, **kw):
+            return {"input_ids": [[(ord(c) % 250) + 1 for c in t[:20]] for t in texts]}
+
+    enc = JaxBertTextEncoder(params, cfg, StubTokenizer(), max_length=64,
+                             batch_size=2, e5_prefixes=False)
+    texts = ["alpha", "a much longer text about code", "b", "medium length text"]
+    vecs = enc.encode(texts)
+    assert vecs.shape == (4, cfg.hidden_size)
+    # per-text determinism regardless of batch composition
+    single = enc.encode([texts[2]])
+    np.testing.assert_allclose(vecs[2], single[0], atol=1e-5)
+
+
+def test_hashing_encoder_similarity_tracks_overlap():
+    enc = HashingTextEncoder(dim=384)
+    vecs = enc.encode([
+        "def ingest_component(repo, namespace)",
+        "the ingest_component function handles a repo",
+        "completely unrelated text about weather patterns",
+    ])
+    assert vecs.shape == (3, 384)
+    sim_related = float(vecs[0] @ vecs[1])
+    sim_unrelated = float(vecs[0] @ vecs[2])
+    assert sim_related > sim_unrelated
+    assert np.linalg.norm(vecs, axis=1) == pytest.approx([1.0, 1.0, 1.0], abs=1e-5)
+
+
+def test_hashing_encoder_deterministic():
+    a = HashingTextEncoder(dim=384).encode(["some text"])
+    b = HashingTextEncoder(dim=384).encode(["some text"])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_get_encoder_falls_back_to_hashing(monkeypatch):
+    from githubrepostorag_tpu import embedding
+
+    embedding.set_encoder(None)
+    monkeypatch.setenv("EMBED_MODEL", "/nonexistent/path")
+    from githubrepostorag_tpu.config import reload_settings
+
+    reload_settings()
+    enc = embedding.get_encoder()
+    assert isinstance(enc, HashingTextEncoder)
+    embedding.set_encoder(None)
