@@ -147,13 +147,16 @@ class JaxBertTextEncoder:
             )
             max_len = max(len(x) for x in enc["input_ids"])
             bucket = next_bucket(max_len, self.max_length)
-            ids = np.zeros((len(idx), bucket), dtype=np.int32)
-            mask = np.zeros((len(idx), bucket), dtype=np.int32)
+            # bucket the row dim too: distinct partial-batch sizes must not
+            # each compile a fresh XLA program
+            rows = next_bucket(len(idx), self.batch_size, minimum=8)
+            ids = np.zeros((rows, bucket), dtype=np.int32)
+            mask = np.zeros((rows, bucket), dtype=np.int32)
             for row, toks in enumerate(enc["input_ids"]):
                 ids[row, : len(toks)] = toks
                 mask[row, : len(toks)] = 1
             vecs = embed(self.params, self.cfg, jnp.asarray(ids), jnp.asarray(mask))
-            out[idx] = np.asarray(vecs)
+            out[idx] = np.asarray(vecs)[: len(idx)]
         return out
 
 
@@ -173,7 +176,13 @@ def get_encoder() -> TextEncoder:
             logger.info("embedding: JAX BERT encoder from %s", model)
         else:
             _encoder = HashingTextEncoder()
-            logger.info("embedding: hashing fallback encoder (no local checkpoint at %r)", model)
+            logger.warning(
+                "embedding: EMBED_MODEL=%r is not a local checkpoint directory — "
+                "falling back to the lexical hashing encoder. Retrieval quality is "
+                "degraded until a local BERT checkpoint is mounted and EMBED_MODEL "
+                "points at it.",
+                model,
+            )
     return _encoder
 
 

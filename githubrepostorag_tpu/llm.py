@@ -1,0 +1,323 @@
+"""LLM client layer: one protocol, three backends.
+
+The reference has two divergent QwenLLM clients (worker's
+rag_worker/src/worker/services/qwen_llm.py and ingest's
+ingest/src/app/llm_init.py) with drifting behavior.  Here one protocol
+serves both callers, with the load-bearing behaviors preserved:
+  - errors travel as text, never raise (qwen_llm.py:146-148) — the agent
+    loop's robustness depends on it
+  - chain-of-thought sanitization (<think> blocks, role markers, chatty
+    prefixes — llm_init.py:36-48)
+  - selector-prompt cleanup with the malformed-JSON choice cascade
+    (qwen_llm.py:54-102)
+
+Backends:
+  - ``InProcessLLM`` — the in-tree TPU engine, no HTTP hop (single-pod).
+  - ``HTTPLLM`` — OpenAI-compatible endpoint (QWEN_ENDPOINT), for split
+    deployments; same wire protocol the reference speaks.
+  - ``FakeLLM`` — scripted/deterministic responses for tests (the
+    scripted-JSON plan/judge fake SURVEY.md §4 calls for).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import threading
+from typing import Callable, Iterator, Protocol, Sequence
+
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.utils.json_utils import extract_choice, sanitize_llm_text, strip_fences
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_SELECTOR_RE = re.compile(r"respond with (?:only )?(?:the )?(?:number|choice)", re.IGNORECASE)
+
+
+def _is_selector_prompt(prompt: str) -> bool:
+    return bool(_SELECTOR_RE.search(prompt)) or "Select the best option" in prompt
+
+
+def _postprocess(prompt: str, text: str) -> str:
+    text = sanitize_llm_text(strip_fences(text).strip()).strip()
+    if _is_selector_prompt(prompt):
+        return extract_choice(text)
+    return text
+
+
+class LLM(Protocol):
+    def complete(
+        self,
+        prompt: str,
+        *,
+        system: str | None = None,
+        max_tokens: int | None = None,
+        temperature: float | None = None,
+    ) -> str: ...
+
+    def stream_complete(
+        self,
+        prompt: str,
+        *,
+        system: str | None = None,
+        max_tokens: int | None = None,
+        temperature: float | None = None,
+        on_text: Callable[[str], None] | None = None,
+    ) -> Iterator[str]:
+        """Yield text deltas; callers that don't care iterate to exhaustion."""
+        ...
+
+
+class FakeLLM:
+    """Deterministic scripted LLM.  ``script`` maps a regex (matched against
+    the prompt) to a response or callable; unmatched prompts get
+    ``default``.  Records every call for assertions."""
+
+    def __init__(self, script: dict[str, str | Callable[[str], str]] | None = None,
+                 default: str = "FAKE_ANSWER") -> None:
+        self.script = script or {}
+        self.default = default
+        self.calls: list[dict] = []
+
+    def complete(self, prompt, *, system=None, max_tokens=None, temperature=None) -> str:
+        self.calls.append({"prompt": prompt, "system": system,
+                           "max_tokens": max_tokens, "temperature": temperature})
+        for pattern, response in self.script.items():
+            if re.search(pattern, prompt, re.DOTALL | re.IGNORECASE):
+                text = response(prompt) if callable(response) else response
+                return _postprocess(prompt, text)
+        return _postprocess(prompt, self.default)
+
+    def stream_complete(self, prompt, *, system=None, max_tokens=None,
+                        temperature=None, on_text=None) -> Iterator[str]:
+        text = self.complete(prompt, system=system, max_tokens=max_tokens,
+                             temperature=temperature)
+        # stream in word-ish chunks so consumers exercise their delta paths
+        for piece in re.findall(r"\S+\s*|\s+", text):
+            if on_text:
+                on_text(piece)
+            yield piece
+
+
+class InProcessLLM:
+    """Directly drives the in-tree AsyncEngine from sync callers (the agent
+    loop and ingest run in worker threads; the engine's asyncio loop lives
+    in a dedicated background thread here)."""
+
+    def __init__(self, async_engine, tokenizer, *,
+                 default_max_tokens: int | None = None,
+                 default_temperature: float | None = None,
+                 context_window: int | None = None) -> None:
+        s = get_settings()
+        self.engine = async_engine
+        self.tokenizer = tokenizer
+        self.default_max_tokens = default_max_tokens or s.qwen_max_output
+        self.default_temperature = (
+            s.qwen_temperature if default_temperature is None else default_temperature
+        )
+        self.context_window = context_window or s.context_window
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: threading.Thread | None = None
+        self._loop_ready = threading.Event()
+
+    # -- background asyncio loop ------------------------------------------
+
+    def _ensure_loop(self) -> asyncio.AbstractEventLoop:
+        if self._loop is None:
+            def run() -> None:
+                loop = asyncio.new_event_loop()
+                asyncio.set_event_loop(loop)
+                self._loop = loop
+                self._loop_ready.set()
+                loop.run_forever()
+
+            self._loop_thread = threading.Thread(target=run, name="llm-loop", daemon=True)
+            self._loop_thread.start()
+            self._loop_ready.wait()
+        return self._loop
+
+    def _messages(self, prompt: str, system: str | None) -> list[dict]:
+        messages = []
+        if system:
+            messages.append({"role": "system", "content": system})
+        messages.append({"role": "user", "content": prompt})
+        return messages
+
+    def _prompt_ids(self, prompt: str, system: str | None) -> list[int]:
+        ids = self.tokenizer.encode_chat(self._messages(prompt, system))
+        # context budget: keep the tail (the reference truncates inputs
+        # upstream; this is the final guard)
+        budget = self.context_window - 64
+        return ids[-budget:] if len(ids) > budget else ids
+
+    def _sampling(self, max_tokens, temperature):
+        from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+        s = get_settings()
+        return SamplingParams(
+            temperature=self.default_temperature if temperature is None else temperature,
+            top_p=s.qwen_top_p,
+            max_tokens=max_tokens or self.default_max_tokens,
+            stop_token_ids=(self.tokenizer.eos_token_id,),
+        )
+
+    def complete(self, prompt, *, system=None, max_tokens=None, temperature=None) -> str:
+        loop = self._ensure_loop()
+        fut = asyncio.run_coroutine_threadsafe(
+            self.engine.generate(self._prompt_ids(prompt, system),
+                                 self._sampling(max_tokens, temperature)),
+            loop,
+        )
+        try:
+            result = fut.result(timeout=get_settings().job_timeout_seconds)
+        except Exception as exc:  # noqa: BLE001 - errors travel as text
+            logger.error("InProcessLLM error: %s", exc)
+            return f"Error: {exc}"
+        if result.finish_reason == "error":
+            return f"Error: {result.error}"
+        return _postprocess(prompt, self.tokenizer.decode(result.output_tokens))
+
+    def stream_complete(self, prompt, *, system=None, max_tokens=None,
+                        temperature=None, on_text=None) -> Iterator[str]:
+        from githubrepostorag_tpu.serving.tokenizer import StreamingDetokenizer
+
+        loop = self._ensure_loop()
+
+        async def pump():
+            detok = StreamingDetokenizer(self.tokenizer)
+            async for event in self.engine.stream(self._prompt_ids(prompt, system),
+                                                  self._sampling(max_tokens, temperature)):
+                if event.type == "token":
+                    delta = detok.push(event.token_id)
+                    if delta:
+                        sync_q.put(delta)
+                else:
+                    tail = detok.flush()
+                    if tail:
+                        sync_q.put(tail)
+            sync_q.put(None)
+
+        import queue as _queue
+
+        sync_q: "_queue.Queue[str | None]" = _queue.Queue()
+        asyncio.run_coroutine_threadsafe(pump(), loop)
+        while True:
+            delta = sync_q.get()
+            if delta is None:
+                return
+            if on_text:
+                on_text(delta)
+            yield delta
+
+
+class HTTPLLM:
+    """OpenAI-compatible HTTP client (split deployments; also exactly what
+    the reference's two clients did, unified)."""
+
+    def __init__(self, endpoint: str | None = None, model: str | None = None,
+                 timeout: float = 60.0) -> None:
+        s = get_settings()
+        self.endpoint = (endpoint or s.qwen_endpoint).rstrip("/")
+        self.model = model or s.qwen_model
+        self.timeout = timeout
+
+    def complete(self, prompt, *, system=None, max_tokens=None, temperature=None) -> str:
+        import requests
+
+        s = get_settings()
+        messages = []
+        if system:
+            messages.append({"role": "system", "content": system})
+        messages.append({"role": "user", "content": prompt})
+        payload = {
+            "model": self.model,
+            "messages": messages,
+            "max_completion_tokens": max_tokens or s.qwen_max_output,
+            "temperature": s.qwen_temperature if temperature is None else temperature,
+            "top_p": s.qwen_top_p,
+        }
+        try:
+            resp = requests.post(
+                f"{self.endpoint}/v1/chat/completions", json=payload, timeout=self.timeout
+            )
+            resp.raise_for_status()
+            text = resp.json()["choices"][0]["message"]["content"]
+        except Exception as exc:  # noqa: BLE001 - errors travel as text
+            logger.error("HTTPLLM error: %s", exc)
+            return f"Error: {exc}"
+        return _postprocess(prompt, text)
+
+    def stream_complete(self, prompt, *, system=None, max_tokens=None,
+                        temperature=None, on_text=None) -> Iterator[str]:
+        import requests
+
+        s = get_settings()
+        messages = []
+        if system:
+            messages.append({"role": "system", "content": system})
+        messages.append({"role": "user", "content": prompt})
+        payload = {
+            "model": self.model,
+            "messages": messages,
+            "max_completion_tokens": max_tokens or s.qwen_max_output,
+            "temperature": s.qwen_temperature if temperature is None else temperature,
+            "top_p": s.qwen_top_p,
+            "stream": True,
+        }
+        try:
+            with requests.post(
+                f"{self.endpoint}/v1/chat/completions", json=payload,
+                timeout=self.timeout, stream=True,
+            ) as resp:
+                resp.raise_for_status()
+                for line in resp.iter_lines(decode_unicode=True):
+                    if not line or not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        return
+                    import json as _json
+
+                    delta = (
+                        _json.loads(data)["choices"][0].get("delta", {}).get("content")
+                    )
+                    if delta:
+                        if on_text:
+                            on_text(delta)
+                        yield delta
+        except Exception as exc:  # noqa: BLE001
+            logger.error("HTTPLLM stream error: %s", exc)
+            yield f"Error: {exc}"
+
+
+def get_llm(on_build: Callable[[], tuple] | None = None) -> LLM:
+    """Build the configured backend (LLM_BACKEND: inprocess | http | fake).
+
+    ``inprocess`` needs an engine+tokenizer; deployments construct those at
+    startup and call set_llm().  This factory covers http/fake and raises a
+    clear error otherwise."""
+    backend = get_settings().llm_backend.lower()
+    if backend == "fake":
+        return FakeLLM()
+    if backend == "http":
+        return HTTPLLM()
+    raise RuntimeError(
+        "LLM_BACKEND=inprocess requires explicit wiring (engine + tokenizer); "
+        "call set_llm(InProcessLLM(...)) at service startup"
+    )
+
+
+_llm: LLM | None = None
+
+
+def get_shared_llm() -> LLM:
+    global _llm
+    if _llm is None:
+        _llm = get_llm()
+    return _llm
+
+
+def set_llm(llm: LLM | None) -> None:
+    global _llm
+    _llm = llm

@@ -1,0 +1,129 @@
+"""Async facade over the synchronous Engine: a dedicated driver thread turns
+engine.step() into per-request asyncio streams.
+
+The TPU never waits on the event loop and the event loop never blocks on the
+TPU: the driver thread spins steps while work exists (continuous batching),
+and token/final events hop into asyncio queues via call_soon_threadsafe —
+the same one-way thread->loop bridge the reference uses for progress events
+(worker.py:55-70, asyncio.run_coroutine_threadsafe), generalized to token
+granularity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from githubrepostorag_tpu.serving.engine import Engine, GenerationResult
+from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class StreamEvent:
+    type: str  # "token" | "final"
+    token_id: int | None = None
+    result: GenerationResult | None = None
+
+
+class AsyncEngine:
+    def __init__(self, engine: Engine) -> None:
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._queues: dict[str, asyncio.Queue[StreamEvent]] = {}
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._loop = asyncio.get_running_loop()
+        self._thread = threading.Thread(target=self._drive, name="engine-driver", daemon=True)
+        self._thread.start()
+
+    async def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _drive(self) -> None:
+        while not self._stop:
+            with self._lock:
+                has_work = self.engine.has_work()
+                finished = self.engine.step() if has_work else []
+            for res in finished:
+                self._emit(res.request_id, StreamEvent(type="final", result=res))
+            if not has_work:
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+
+    def _emit(self, rid: str, event: StreamEvent) -> None:
+        q = self._queues.get(rid)
+        if q is None or self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(q.put_nowait, event)
+
+    # ------------------------------------------------------------- serving
+
+    async def stream(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None = None,
+        request_id: str | None = None,
+    ) -> AsyncIterator[StreamEvent]:
+        """Submit a request and yield token events then the final event."""
+        await self.start()
+        q: asyncio.Queue[StreamEvent] = asyncio.Queue()
+
+        def on_token(rid: str, token_id: int) -> None:
+            self._emit(rid, StreamEvent(type="token", token_id=token_id))
+
+        with self._lock:
+            rid = self.engine.add_request(
+                prompt_ids, sampling, on_token=on_token, request_id=request_id
+            )
+            self._queues[rid] = q
+        self._wake.set()
+        try:
+            while True:
+                event = await q.get()
+                yield event
+                if event.type == "final":
+                    return
+        finally:
+            self._queues.pop(rid, None)
+
+    async def generate(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None = None,
+        request_id: str | None = None,
+    ) -> GenerationResult:
+        async for event in self.stream(prompt_ids, sampling, request_id):
+            if event.type == "final":
+                return event.result
+        raise RuntimeError("stream ended without a final event")  # pragma: no cover
+
+    async def cancel(self, request_id: str) -> None:
+        with self._lock:
+            self.engine.cancel(request_id)
+        self._wake.set()
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "running": self.engine.num_running,
+                "waiting": self.engine.num_waiting,
+                "free_pages": self.engine._allocator.free_count,
+                "total_pages": self.engine._allocator.num_pages,
+            }

@@ -16,7 +16,7 @@ from githubrepostorag_tpu.models.encoder import (
 )
 
 transformers = pytest.importorskip("transformers")
-import torch  # noqa: E402
+torch = pytest.importorskip("torch")
 
 
 @pytest.fixture(scope="module")

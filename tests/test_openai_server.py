@@ -1,0 +1,193 @@
+"""OpenAI-compatible server end-to-end over a real TCP socket: chat
+completions (stream + non-stream), completions, stop strings, health,
+64-way concurrency shape, and the InProcessLLM client."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.models import Qwen2Config, init_params
+from githubrepostorag_tpu.serving import Engine, SamplingParams
+from githubrepostorag_tpu.serving.async_engine import AsyncEngine
+from githubrepostorag_tpu.serving.openai_api import OpenAIServer
+from githubrepostorag_tpu.serving.tokenizer import ByteTokenizer, StreamingDetokenizer
+
+
+def _build_server(max_num_seqs=4, num_pages=256, max_seq_len=256):
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = Engine(
+        params, cfg, max_num_seqs=max_num_seqs, num_pages=num_pages, page_size=8,
+        max_seq_len=max_seq_len, prefill_chunk=64, kv_dtype=jnp.float32,
+    )
+    tok = ByteTokenizer()
+    return OpenAIServer(AsyncEngine(eng), tok, model_name="tiny-test")
+
+
+async def _with_server(fn, **kw):
+    import aiohttp
+
+    server = _build_server(**kw)
+    port = await server.start(host="127.0.0.1", port=0)
+    try:
+        async with aiohttp.ClientSession() as session:
+            await fn(session, f"http://127.0.0.1:{port}")
+    finally:
+        await server.stop()
+
+
+async def test_chat_completion_roundtrip():
+    async def body(session, base):
+        resp = await session.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 8,
+                "temperature": 0,
+            },
+        )
+        assert resp.status == 200
+        data = await resp.json()
+        assert data["object"] == "chat.completion"
+        assert data["choices"][0]["finish_reason"] in ("stop", "length")
+        assert data["usage"]["completion_tokens"] > 0
+        assert isinstance(data["choices"][0]["message"]["content"], str)
+
+    await _with_server(body)
+
+
+async def test_chat_completion_streaming():
+    async def body(session, base):
+        resp = await session.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "stream please"}],
+                "max_tokens": 8,
+                "temperature": 0,
+                "stream": True,
+            },
+        )
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        chunks, done = [], False
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                done = True
+                break
+            chunks.append(json.loads(payload))
+        assert done
+        assert chunks[0]["object"] == "chat.completion.chunk"
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+        # deltas concatenate to some text
+        text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+        assert isinstance(text, str)
+
+    await _with_server(body)
+
+
+async def test_completions_endpoint_and_models_and_health():
+    async def body(session, base):
+        resp = await session.post(
+            f"{base}/v1/completions",
+            json={"prompt": "abc", "max_tokens": 4, "temperature": 0},
+        )
+        data = await resp.json()
+        assert data["object"] == "text_completion"
+
+        models = await (await session.get(f"{base}/v1/models")).json()
+        assert models["data"][0]["id"] == "tiny-test"
+
+        health = await (await session.get(f"{base}/health")).json()
+        assert health["status"] == "ok"
+        assert "free_pages" in health
+
+    await _with_server(body)
+
+
+async def test_malformed_request_400():
+    async def body(session, base):
+        resp = await session.post(f"{base}/v1/chat/completions", data=b"not json")
+        assert resp.status == 400
+        err = await resp.json()
+        assert "error" in err
+
+        resp2 = await session.post(f"{base}/v1/chat/completions", json={"nope": 1})
+        assert resp2.status == 400
+
+    await _with_server(body)
+
+
+async def test_concurrent_streams():
+    """BASELINE config #5 shape: many concurrent SSE streams sharing the
+    continuous batch (scaled down for CPU)."""
+
+    async def body(session, base):
+        async def one(i):
+            resp = await session.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "messages": [{"role": "user", "content": f"req {i}"}],
+                    "max_tokens": 6,
+                    "temperature": 0.5,
+                    "stream": True,
+                },
+            )
+            n_done = 0
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if line == "data: [DONE]":
+                    n_done += 1
+            return n_done
+
+        results = await asyncio.gather(*(one(i) for i in range(8)))
+        assert all(r == 1 for r in results)
+
+    await _with_server(body, max_num_seqs=4)  # more streams than batch slots
+
+
+def test_streaming_detokenizer_utf8_boundaries():
+    tok = ByteTokenizer()
+    detok = StreamingDetokenizer(tok)
+    text = "héllo 世界"
+    out = ""
+    for b in text.encode("utf-8"):
+        out += detok.push(b)
+    out += detok.flush()
+    assert out == text
+
+
+def test_inprocess_llm_client():
+    from githubrepostorag_tpu.llm import InProcessLLM
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = Engine(params, cfg, max_num_seqs=2, num_pages=128, page_size=8,
+                 max_seq_len=256, prefill_chunk=64, kv_dtype=jnp.float32)
+    llm = InProcessLLM(AsyncEngine(eng), ByteTokenizer(),
+                       default_max_tokens=8, default_temperature=0.0)
+    out = llm.complete("What does this repo do?")
+    assert isinstance(out, str)
+    deltas = list(llm.stream_complete("stream this", max_tokens=6))
+    assert "".join(deltas) is not None
+
+
+def test_fake_llm_scripting():
+    from githubrepostorag_tpu.llm import FakeLLM
+
+    llm = FakeLLM(script={
+        r"plan the scope": '{"scope": "repo", "filters": {}}',
+        r"respond with only the number": "I think the answer is 3.",
+    })
+    assert llm.complete("Please plan the scope for this query") == '{"scope": "repo", "filters": {}}'
+    # selector prompts go through the choice cascade
+    assert llm.complete("Pick one. respond with only the number") == "3"
+    assert llm.calls[0]["prompt"].startswith("Please plan")
