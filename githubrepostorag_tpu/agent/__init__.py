@@ -1,0 +1,8 @@
+"""L3: the agentic query engine — plan -> retrieve -> judge -> rewrite ->
+synthesize, rebuilt from the reference's LangGraph agent
+(rag_worker/src/worker/services/agent_graph.py) as a plain state machine."""
+
+from githubrepostorag_tpu.agent.graph import AgentResult, GraphAgent
+from githubrepostorag_tpu.agent.state import AgentState
+
+__all__ = ["GraphAgent", "AgentResult", "AgentState"]

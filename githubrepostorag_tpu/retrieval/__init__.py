@@ -1,0 +1,11 @@
+"""L2: scoped retrieval with metadata-edge graph expansion over the vector
+store (the rebuild of the reference's GraphRetriever-per-scope factory,
+rag_worker/src/worker/services/graph_rag_retrievers.py)."""
+
+from githubrepostorag_tpu.retrieval.retrievers import (
+    RetrievedDoc,
+    RetrieverFactory,
+    ScopeRetriever,
+)
+
+__all__ = ["RetrievedDoc", "RetrieverFactory", "ScopeRetriever"]

@@ -1,0 +1,143 @@
+"""Per-scope retrievers: ANN seed -> metadata-edge graph traversal.
+
+Rebuilds the reference's query-time retriever factory
+(graph_rag_retrievers.py:104-134: LangChain GraphRetriever with the Eager
+strategy per scope; edges are equal-value metadata joins on
+namespace/repo/module/file_path; fan-out k 6-10, start_k 2-3, adjacent_k
+6-8, max_depth 2) directly over the VectorStore interface — no LangChain.
+
+Traversal: seed with ANN top-``start_k``; walk edges breadth-first up to
+``max_depth``, pulling up to ``adjacent_k`` neighbors per edge via the
+metadata-entries index; score every candidate by cosine to the query;
+return the top ``k``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.embedding import TextEncoder, get_encoder
+from githubrepostorag_tpu.store.base import VectorStore
+
+
+@dataclass
+class RetrievedDoc:
+    doc_id: str
+    text: str
+    metadata: dict[str, str]
+    score: float
+    depth: int = 0  # 0 = ANN seed, >0 = reached via edge traversal
+
+
+@dataclass(frozen=True)
+class ScopeSpec:
+    table_key: str  # key into Settings.scope_tables
+    k: int
+    start_k: int
+    adjacent_k: int
+    max_depth: int
+    edges: tuple[str, ...]  # metadata keys joined on equality
+
+
+# Fan-out parameters mirror graph_rag_retrievers.py:104-134; edge sets follow
+# the hierarchy (an L4 chunk connects to its file's other chunks, its module,
+# and its repo).  The catalog scope IS routable here — the reference wrote
+# embeddings_catalog but never queried it (SURVEY.md Appendix A).
+SCOPE_SPECS: dict[str, ScopeSpec] = {
+    "catalog": ScopeSpec("catalog", k=4, start_k=2, adjacent_k=4, max_depth=1, edges=("namespace",)),
+    "repo": ScopeSpec("repo", k=6, start_k=2, adjacent_k=6, max_depth=2, edges=("namespace",)),
+    "module": ScopeSpec("module", k=8, start_k=3, adjacent_k=8, max_depth=2, edges=("repo",)),
+    "file": ScopeSpec("file", k=10, start_k=3, adjacent_k=8, max_depth=2, edges=("module", "repo")),
+    "chunk": ScopeSpec("chunk", k=10, start_k=3, adjacent_k=8, max_depth=2, edges=("file_path", "module")),
+}
+
+# The canonical five-level ladder, broadest to narrowest.  The agent's
+# stage-down routing and prompt vocabulary import THIS — one source of truth.
+SCOPE_LADDER = ["catalog", "repo", "module", "file", "chunk"]
+
+
+class ScopeRetriever:
+    def __init__(
+        self,
+        store: VectorStore,
+        encoder: TextEncoder,
+        scope: str,
+        spec: ScopeSpec | None = None,
+        table: str | None = None,
+    ) -> None:
+        self.store = store
+        self.encoder = encoder
+        self.scope = scope
+        self.spec = spec or SCOPE_SPECS[scope]
+        self.table = table or get_settings().scope_tables[self.spec.table_key]
+
+    def retrieve(self, query: str, filters: Mapping[str, str] | None = None) -> list[RetrievedDoc]:
+        spec = self.spec
+        qvec = self.encoder.encode([query], kind="query")[0]
+        flt = dict(filters or {})
+
+        seeds = self.store.search(self.table, qvec, spec.start_k, filter=flt)
+        found: dict[str, RetrievedDoc] = {}
+        for hit in seeds:
+            found[hit.doc.doc_id] = RetrievedDoc(
+                hit.doc.doc_id, hit.doc.text, dict(hit.doc.metadata), hit.score, depth=0
+            )
+
+        qnorm = np.linalg.norm(qvec)
+        frontier = list(found.values())
+        for depth in range(1, spec.max_depth + 1):
+            next_frontier: list[RetrievedDoc] = []
+            for doc in frontier:
+                for edge_key in spec.edges:
+                    edge_val = doc.metadata.get(edge_key)
+                    if not edge_val:
+                        continue
+                    edge_filter = dict(flt)
+                    edge_filter[edge_key] = edge_val
+                    for adj in self.store.find_by_metadata(
+                        self.table, edge_filter, limit=spec.adjacent_k
+                    ):
+                        if adj.doc_id in found:
+                            continue
+                        score = 0.0
+                        if adj.vector is not None and qnorm > 0:
+                            v = np.asarray(adj.vector, dtype=np.float32)
+                            vn = np.linalg.norm(v)
+                            if vn > 0:
+                                score = float(v @ qvec / (vn * qnorm))
+                        rd = RetrievedDoc(adj.doc_id, adj.text, dict(adj.metadata), score, depth=depth)
+                        found[adj.doc_id] = rd
+                        next_frontier.append(rd)
+            frontier = next_frontier
+            if not frontier:
+                break
+
+        ranked = sorted(found.values(), key=lambda d: d.score, reverse=True)
+        return ranked[: spec.k]
+
+
+class RetrieverFactory:
+    """One retriever per scope over a shared store + encoder (the reference
+    rebuilt a Cassandra session and HF embedder per factory; here both are
+    process-wide singletons)."""
+
+    def __init__(self, store: VectorStore | None = None, encoder: TextEncoder | None = None) -> None:
+        from githubrepostorag_tpu.store import get_store
+
+        self.store = store or get_store()
+        self.encoder = encoder or get_encoder()
+        self._cache: dict[str, ScopeRetriever] = {}
+
+    def for_scope(self, scope: str) -> ScopeRetriever:
+        if scope not in SCOPE_SPECS:
+            raise KeyError(f"unknown scope {scope!r}; valid: {list(SCOPE_SPECS)}")
+        if scope not in self._cache:
+            self._cache[scope] = ScopeRetriever(self.store, self.encoder, scope)
+        return self._cache[scope]
+
+    def retrieve(self, scope: str, query: str, filters: Mapping[str, str] | None = None) -> list[RetrievedDoc]:
+        return self.for_scope(scope).retrieve(query, filters)

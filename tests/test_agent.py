@@ -1,0 +1,198 @@
+"""Agent loop semantics with a scripted FakeLLM over the in-memory stack:
+planning fallbacks, retrieval expansion, judge stage-down ladder, rewrite
+loop bounds, synthesis budgets, anti-conservative retry."""
+
+import json
+
+import pytest
+
+from githubrepostorag_tpu.agent import GraphAgent
+from githubrepostorag_tpu.embedding import HashingTextEncoder
+from githubrepostorag_tpu.llm import FakeLLM
+from githubrepostorag_tpu.retrieval import RetrieverFactory
+from githubrepostorag_tpu.store import Doc, MemoryVectorStore
+
+PLAN = r"Pick the retrieval scope"
+JUDGE = r"Assess whether the retrieved"
+EXPAND = r"alternative search queries"
+REWRITE = r"Rephrase this question"
+SYNTH = r"senior engineer"
+ENCOURAGE = r"helpful engineer"
+
+
+@pytest.fixture
+def stack():
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    texts = {
+        "chunk": [
+            ("c1", "def ingest_component(repo): run the ingest pipeline stages",
+             {"repo": "coderag", "module": "ingest", "file_path": "ingest/controller.py"}),
+            ("c2", "def run_rag_job(ctx, job_id): drive the agent and emit events",
+             {"repo": "coderag", "module": "worker", "file_path": "worker/worker.py"}),
+            ("c3", "class GraphAgent: plan retrieve judge rewrite synthesize loop",
+             {"repo": "coderag", "module": "worker", "file_path": "worker/agent.py"}),
+        ],
+        "repo": [
+            ("r1", "coderag: a RAG system over github repositories with hierarchical index " + "x" * 2000,
+             {"repo": "coderag"}),
+        ],
+    }
+    for scope, rows in texts.items():
+        table = {"chunk": "embeddings", "repo": "embeddings_repo"}[scope]
+        docs = []
+        for did, text, meta in rows:
+            meta = {"namespace": "default", "scope": scope, **meta}
+            docs.append(Doc(did, text, meta, enc.encode([text])[0]))
+        store.upsert(table, docs)
+    return store, enc
+
+
+def _agent(stack, script, max_iters=3):
+    store, enc = stack
+    llm = FakeLLM(script=script, default="generic answer [1]")
+    return GraphAgent(llm, RetrieverFactory(store, enc), max_iters=max_iters, namespace="default"), llm
+
+
+def test_happy_path_single_iteration(stack):
+    agent, llm = _agent(stack, {
+        PLAN: '{"scope": "chunk", "filters": {}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+        SYNTH: "The ingest pipeline runs via ingest_component [1].",
+    })
+    events = []
+    res = agent.run("how does the ingest pipeline run?", progress_cb=events.append)
+    assert "ingest_component" in res.answer
+    assert res.sources and res.sources[0]["doc_id"].startswith("c")
+    stages = [e["stage"] for e in events]
+    assert stages[0] == "plan"
+    assert "retrieve" in stages and "judge" in stages and "synthesize" in stages
+    assert res.debug["final_scope"] == "chunk"
+
+
+def test_plan_garbage_falls_back_to_heuristic(stack):
+    # codey question -> chunk; overview question -> repo
+    agent, _ = _agent(stack, {
+        PLAN: "utter nonsense, no json here",
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    res = agent.run("why does this function throw an exception?")
+    assert any(t["scope"] == "chunk" for t in res.debug["turns"] if t["stage"] == "plan")
+
+    agent2, _ = _agent(stack, {
+        PLAN: "still nonsense",
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    res2 = agent2.run("give me a summary of the architecture")
+    assert any(t["scope"] == "repo" for t in res2.debug["turns"] if t["stage"] == "plan")
+
+
+def test_judge_parse_failure_stages_down(stack):
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "repo", "filters": {}}',
+        JUDGE: "no json at all",
+    }, max_iters=2)
+    res = agent.run("what repositories exist?")
+    judges = [t for t in res.debug["turns"] if t["stage"] == "judge"]
+    assert judges[0]["decision"]["stage_down"] == "module"
+
+
+def test_low_coverage_auto_stages_down_ladder(stack):
+    coverages = iter(['{"coverage": 0.1, "needs_more": true}',
+                      '{"coverage": 0.9, "needs_more": false}'])
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "repo", "filters": {}}',
+        JUDGE: lambda p: next(coverages),
+        REWRITE: "sharper question about the ingest pipeline",
+        EXPAND: '["alt one", "alt two"]',
+    })
+    res = agent.run("tell me about ingest")
+    scopes = [t.get("scope") for t in res.debug["turns"] if t["stage"] == "retrieve"]
+    assert scopes[0] == "repo"
+    assert scopes[1] == "module"  # one rung down after coverage 0.1
+
+
+def test_retry_loop_bounded_by_max_iters(stack):
+    agent, llm = _agent(stack, {
+        PLAN: '{"scope": "chunk", "filters": {}}',
+        JUDGE: '{"coverage": 0.5, "needs_more": true}',  # always wants more
+        REWRITE: "rewritten question about workers",
+        EXPAND: '["expansion a", "expansion b"]',
+    }, max_iters=3)
+    res = agent.run("an unanswerable question")
+    retrieves = [t for t in res.debug["turns"] if t["stage"] == "retrieve"]
+    assert len(retrieves) == 3  # initial + 2 retries, then forced synthesis
+    ends = [t for t in res.debug["turns"] if t.get("reason") == "max_iters"]
+    assert ends
+
+
+def test_semantic_expansion_fills_sparse_results(stack):
+    agent, llm = _agent(stack, {
+        PLAN: '{"scope": "chunk", "filters": {}}',
+        EXPAND: '["agent loop class", "rag job worker"]',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    # "???" has no word tokens -> zero embedding -> zero ANN hits, so the
+    # semantic expansion path is the only way to fill results
+    res = agent.run("???")
+    expanded = [t for t in res.debug["turns"] if t["stage"] == "retrieve_expanded"]
+    assert expanded, "expansion should have been attempted and recorded"
+    assert expanded[0]["expanded_hits"] > expanded[0]["original_hits"]
+    assert res.sources, "expanded docs should flow into synthesis"
+
+
+def test_anti_conservative_retry(stack):
+    agent, llm = _agent(stack, {
+        PLAN: '{"scope": "chunk", "filters": {}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+        ENCOURAGE: "Here are the projects: coderag does X [1].",
+        SYNTH: "I don't have enough information to answer.",
+    })
+    res = agent.run("what does the worker do?")
+    assert "coderag does X" in res.answer
+    assert res.debug.get("synthesis_retry") == "overcame_conservative_answer"
+
+
+def test_force_level_and_repo_hint(stack):
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "chunk", "filters": {}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    res = agent.run("summarize repo: coderag please", force_level="repo")
+    plans = [t for t in res.debug["turns"] if t["stage"] == "plan"]
+    assert plans[-1].get("forced") is True
+    retrieves = [t for t in res.debug["turns"] if t["stage"] == "retrieve"]
+    assert retrieves[0]["scope"] == "repo"
+    assert retrieves[0]["filters"].get("repo") == "coderag"
+
+
+def test_source_text_budget(stack):
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "repo", "filters": {}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    res = agent.run("describe the coderag repository")
+    assert res.sources
+    assert all(len(s["text"]) <= 1200 for s in res.sources)
+
+
+def test_filter_list_values_normalized(stack):
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "chunk", "filters": {"repos": ["coderag"]}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    res = agent.run("how does the agent work?")
+    retrieves = [t for t in res.debug["turns"] if t["stage"] == "retrieve"]
+    assert retrieves[0]["filters"].get("repo") == "coderag"
+
+
+def test_progress_callback_errors_do_not_kill_run(stack):
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "chunk", "filters": {}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+
+    def bad_cb(event):
+        raise RuntimeError("boom")
+
+    res = agent.run("how does ingest work?", progress_cb=bad_cb)
+    assert res.answer

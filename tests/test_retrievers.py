@@ -1,0 +1,78 @@
+"""Scoped graph retrievers: ANN seeding, metadata-edge traversal, ranking."""
+
+import numpy as np
+
+from githubrepostorag_tpu.embedding import HashingTextEncoder
+from githubrepostorag_tpu.retrieval import RetrieverFactory
+from githubrepostorag_tpu.retrieval.retrievers import SCOPE_SPECS, ScopeRetriever
+from githubrepostorag_tpu.store import Doc, MemoryVectorStore
+
+
+def _seed(store, encoder):
+    chunks = [
+        ("c1", "def create_job(): enqueue rag job", {"repo": "svc", "module": "api", "file_path": "api/jobs.py"}),
+        ("c2", "def cancel_job(): set cancel flag", {"repo": "svc", "module": "api", "file_path": "api/jobs.py"}),
+        ("c3", "class ProgressBus: redis pubsub events", {"repo": "svc", "module": "bus", "file_path": "bus/bus.py"}),
+        ("c4", "helm values for cassandra statefulset", {"repo": "infra", "module": "helm", "file_path": "helm/values.yaml"}),
+    ]
+    docs = []
+    for did, text, meta in chunks:
+        meta = {"namespace": "default", **meta}
+        vec = encoder.encode([text])[0]
+        docs.append(Doc(did, text, meta, vec))
+    store.upsert("embeddings", docs)
+
+
+def test_ann_seed_plus_edge_traversal_pulls_same_file_chunks():
+    from githubrepostorag_tpu.retrieval.retrievers import ScopeSpec
+
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    _seed(store, enc)
+    # start_k=1 so only c1 can seed; c2 must arrive via the file_path edge
+    spec = ScopeSpec("chunk", k=10, start_k=1, adjacent_k=8, max_depth=2,
+                     edges=("file_path", "module"))
+    r = ScopeRetriever(store, enc, "chunk", spec=spec)
+    docs = r.retrieve("how do I create a job?", {"namespace": "default"})
+    ids = [d.doc_id for d in docs]
+    assert ids[0] == "c1"  # best ANN match first
+    assert "c2" in ids  # same file_path edge pulled the sibling chunk
+    # seed is depth 0, edge-reached sibling has depth > 0
+    by_id = {d.doc_id: d for d in docs}
+    assert by_id["c1"].depth == 0
+    assert by_id["c2"].depth >= 1
+
+
+def test_filters_restrict_traversal():
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    _seed(store, enc)
+    r = ScopeRetriever(store, enc, "chunk")
+    docs = r.retrieve("cassandra helm values", {"namespace": "default", "repo": "svc"})
+    assert all(d.metadata["repo"] == "svc" for d in docs)
+
+
+def test_k_cap_respected():
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    many = [
+        Doc(f"d{i}", f"function number {i} does work", {"namespace": "default", "repo": "r", "module": "m", "file_path": "f.py"},
+            enc.encode([f"function number {i} does work"])[0])
+        for i in range(30)
+    ]
+    store.upsert("embeddings", many)
+    r = ScopeRetriever(store, enc, "chunk")
+    docs = r.retrieve("function work", {"namespace": "default"})
+    assert len(docs) <= SCOPE_SPECS["chunk"].k
+
+
+def test_factory_caches_and_validates():
+    import pytest
+
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    f = RetrieverFactory(store, enc)
+    assert f.for_scope("repo") is f.for_scope("repo")
+    with pytest.raises(KeyError):
+        f.for_scope("nonsense")
+
+
+def test_empty_store_returns_empty():
+    f = RetrieverFactory(MemoryVectorStore(), HashingTextEncoder())
+    assert f.retrieve("chunk", "anything") == []
