@@ -111,7 +111,7 @@ class GraphAgent:
 
     # ------------------------------------------------------------- stages
 
-    def plan_scope(self, state: AgentState) -> None:
+    def plan_scope(self, state: AgentState, force_level: str | None = None) -> None:
         q = state.query
         if self.namespace:
             state.filters.setdefault("namespace", self.namespace)
@@ -119,13 +119,18 @@ class GraphAgent:
         if hint:
             state.filters["repo"] = hint
 
-        raw = self.llm.complete(prompts.plan_prompt(q))
-        data = extract_json(raw, default=None)
-        if isinstance(data, dict) and data.get("scope") in SCOPE_LADDER:
-            scope = data["scope"]
-            self._merge_filters(state.filters, data.get("filters"))
+        if force_level in SCOPE_LADDER:
+            # skip the planning round-trip entirely; hint/synonym filters
+            # above still apply
+            scope = force_level
         else:
-            scope = "chunk" if looks_codey(q) else "repo"
+            raw = self.llm.complete(prompts.plan_prompt(q))
+            data = extract_json(raw, default=None)
+            if isinstance(data, dict) and data.get("scope") in SCOPE_LADDER:
+                scope = data["scope"]
+                self._merge_filters(state.filters, data.get("filters"))
+            else:
+                scope = "chunk" if looks_codey(q) else "repo"
 
         for tech, terms in TECH_SYNONYMS.items():
             if "topics" in state.filters:
@@ -135,7 +140,10 @@ class GraphAgent:
                 break
 
         state.scope = scope
-        state.breadcrumb("plan", scope=scope, filters=dict(state.filters), attempt=state.attempt)
+        state.breadcrumb(
+            "plan", scope=scope, filters=dict(state.filters), attempt=state.attempt,
+            forced=force_level in SCOPE_LADDER or None,
+        )
 
     def retrieve(self, state: AgentState) -> None:
         retriever = self.retrievers.for_scope(state.scope)
@@ -144,21 +152,21 @@ class GraphAgent:
 
         if (len(docs) < 3 or state.attempt > 0) and len(docs) < self.router_top_k:
             expanded = self._expand_query(state.query, state.filters.get("repo"), state.scope)
+            # collect every expansion candidate first, then rank — capping by
+            # insertion order would drop stronger docs from later queries
             seen = {hash(d.text) for d in docs}
-            all_docs = list(docs)
+            extras: list[RetrievedDoc] = []
             for alt in expanded:
-                if len(all_docs) >= self.router_top_k:
-                    break
                 try:
                     for doc in retriever.retrieve(alt, state.filters):
-                        if len(all_docs) >= self.router_top_k:
-                            break
                         h = hash(doc.text)
                         if h not in seen:
                             seen.add(h)
-                            all_docs.append(doc)
+                            extras.append(doc)
                 except Exception as exc:  # noqa: BLE001 - expansion is best-effort
                     logger.warning("expanded query %r failed: %s", alt, exc)
+            extras.sort(key=lambda d: d.score, reverse=True)
+            all_docs = (list(docs) + extras)[: self.router_top_k]
             if len(all_docs) > original_count:
                 state.breadcrumb(
                     "retrieve_expanded",
@@ -166,7 +174,7 @@ class GraphAgent:
                     expanded_hits=len(all_docs),
                     expanded_queries=expanded,
                 )
-            docs = all_docs[: self.router_top_k]
+            docs = all_docs
 
         docs.sort(key=lambda d: d.score, reverse=True)
         state.docs = docs
@@ -190,7 +198,7 @@ class GraphAgent:
             }
             for i, d in enumerate(state.docs, start=1)
         ]
-        raw = self.llm.complete(prompts.judge_prompt(state.query, inventory))
+        raw = self.llm.complete(prompts.judge_prompt(state.query, inventory, state.scope))
         data = extract_json(raw, default=None)
         if not isinstance(data, dict):
             # parse failure: the ladder keeps moving instead of stalling
@@ -203,7 +211,11 @@ class GraphAgent:
         self._merge_filters(state.filters, data.get("suggest_filters"))
 
         stage_down = data.get("stage_down")
-        if stage_down in SCOPE_LADDER and stage_down != state.scope:
+        cur_idx = SCOPE_LADDER.index(state.scope) if state.scope in SCOPE_LADDER else 0
+        if (
+            stage_down in SCOPE_LADDER
+            and SCOPE_LADDER.index(stage_down) > cur_idx  # only ever drill DOWN
+        ):
             state.scope = stage_down
         elif _as_float(data.get("coverage")) < 0.3 and state.docs:
             state.scope = next_scope_down(state.scope)
@@ -333,12 +345,9 @@ class GraphAgent:
         if namespace or self.namespace:
             state.filters["namespace"] = namespace or self.namespace
 
-        self.plan_scope(state)
-        if force_level in SCOPE_LADDER:
-            # honored here; the reference read force_level but ignored it
-            # (worker.py:101-107, SURVEY.md Appendix A)
-            state.scope = force_level
-            state.breadcrumb("plan", scope=force_level, forced=True)
+        # force_level honored (the reference read it but ignored it —
+        # worker.py:101-107, SURVEY.md Appendix A) and skips the plan LLM call
+        self.plan_scope(state, force_level=force_level)
 
         while True:
             self.retrieve(state)
@@ -380,6 +389,10 @@ class GraphAgent:
         for key, val in suggested.items():
             if key not in canonical and key.endswith("s") and key[:-1] in canonical:
                 key = key[:-1]
+            if key not in canonical:
+                # an unknown key would become an exact-match filter no stored
+                # doc can satisfy, zeroing every later retrieval
+                continue
             if isinstance(val, str) and val:
                 filters[key] = val
             elif isinstance(val, list) and val and isinstance(val[0], str):

@@ -41,14 +41,17 @@ def expansion_prompt(query: str, repo: str | None, scope: str | None) -> str:
     )
 
 
-def judge_prompt(query: str, inventory: list[dict]) -> str:
+def judge_prompt(query: str, inventory: list[dict], current_scope: str) -> str:
+    deeper = SCOPES[min(SCOPES.index(current_scope) + 1, len(SCOPES) - 1)] if current_scope in SCOPES else "chunk"
     return (
         "Assess whether the retrieved items below can answer the question. "
         "Weigh both the metadata and the content previews. Reply with JSON "
         'only: {"coverage": 0.0-1.0, "needs_more": true|false, '
         '"suggest_filters": {"repo": "...", "module": "...", "topics": "..."}, '
-        '"stage_down": "repo|module|file|chunk|null", "rewrite": "optional '
-        'better query"}.\n'
+        '"stage_down": "<a NARROWER scope than the current one, or null>", '
+        '"rewrite": "optional better query"}.\n'
+        f"Current scope: {current_scope} (narrower scopes: "
+        f"{', '.join(SCOPES[SCOPES.index(current_scope) + 1:]) if current_scope in SCOPES else deeper} )\n"
         f"Question: {query}\n"
         f"Retrieved items: {json.dumps(inventory, ensure_ascii=False)}\n"
         "JSON:"

@@ -1,0 +1,14 @@
+"""L3': the index-building pipeline.
+
+Rebuild of the reference's ingest service (ingest/src/app/): load ->
+preprocess -> chunk -> enrich (L4) -> catalog (L0) -> file (L3) -> module
+(L2) -> repo (L1) summaries -> per-scope vector write -> audit, with the
+LLM enrichment stages turned from one-HTTP-call-per-chunk-per-extractor
+(the reference's dominant ingest cost, SURVEY.md §3.2) into batched
+prefill-heavy TPU inference through the in-tree engine.
+"""
+
+from githubrepostorag_tpu.ingest.types import Node, SourceDoc
+from githubrepostorag_tpu.ingest.controller import ingest_component, ingest_many
+
+__all__ = ["SourceDoc", "Node", "ingest_component", "ingest_many"]

@@ -81,3 +81,8 @@ class VectorStore(abc.ABC):
     def health(self) -> dict:
         """Liveness + per-table row counts (feeds the deep /health probe)."""
         return {"status": "UP", "tables": {t: self.count(t) for t in self.tables()}}
+
+    def save(self) -> None:
+        """Flush to durable storage.  No-op for server-backed stores; the
+        local memory/native backends persist their JSON snapshot."""
+        return None

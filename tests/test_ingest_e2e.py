@@ -1,0 +1,152 @@
+"""End-to-end ingest -> query: ingest this repository itself with a scripted
+LLM into the in-memory store, then answer a question through the agent
+(SURVEY.md §7 step 4 / BASELINE config #1, CPU-scale)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from githubrepostorag_tpu.agent import GraphAgent
+from githubrepostorag_tpu.embedding import HashingTextEncoder
+from githubrepostorag_tpu.ingest.controller import ingest_component, ingest_many
+from githubrepostorag_tpu.ingest.sources import LocalRepoReader
+from githubrepostorag_tpu.ingest.types import SourceDoc
+from githubrepostorag_tpu.llm import FakeLLM
+from githubrepostorag_tpu.retrieval import RetrieverFactory
+from githubrepostorag_tpu.store import MemoryVectorStore
+
+INGEST_SCRIPT = {
+    r"Summarize": "Summarized section.",
+    r"short descriptive title": "Section Title",
+    r"technical keywords": "rag, tpu, jax",
+    r"README a useful description": "GOOD",
+    r"200-300 word technical summary": "File-level summary of the source file.",
+    r"summary of this module": "Module-level summary.",
+    r"comprehensive overview": "Repo overview: a TPU-native RAG framework.",
+}
+
+
+@pytest.fixture
+def repo_docs():
+    root = Path(__file__).resolve().parent.parent
+    docs = LocalRepoReader(str(root / "githubrepostorag_tpu")).load()
+    assert len(docs) > 20
+    return docs[:40]  # keep the CPU test quick
+
+
+def test_ingest_populates_all_five_scopes(repo_docs, tmp_path, monkeypatch):
+    monkeypatch.setenv("DATA_DIR", str(tmp_path))
+    from githubrepostorag_tpu.config import reload_settings
+
+    reload_settings()
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    llm = FakeLLM(script=INGEST_SCRIPT)
+    stages = []
+    record = ingest_component(
+        "githubrepostorag-tpu", docs=repo_docs, llm=llm, store=store, encoder=enc,
+        on_stage=lambda s, t: stages.append(s),
+    )
+    assert record["written"]["chunk"] > 10
+    assert record["written"]["file"] > 5
+    assert record["written"]["module"] >= 1
+    assert record["written"]["repo"] == 1
+    assert record["written"]["catalog"] == 1
+    assert set(record["timings"]) >= {
+        "preprocess", "code_nodes", "catalog", "file_summaries",
+        "module_summaries", "repo_summary", "vector_write",
+    }
+    assert stages[0] == "preprocess"
+
+    # audit manifest written and parseable
+    manifest = (tmp_path / "ingest_runs.jsonl").read_text().strip()
+    assert json.loads(manifest)["repo"] == "githubrepostorag-tpu"
+
+    # raw docs dumped for resume
+    assert (tmp_path / "repos" / "githubrepostorag-tpu" / "raw_documents_main.json").exists()
+
+
+def test_reingest_is_idempotent(repo_docs):
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    llm = FakeLLM(script=INGEST_SCRIPT)
+    r1 = ingest_component("repo-a", docs=repo_docs, llm=llm, store=store, encoder=enc)
+    counts_1 = {t: store.count(t) for t in store.tables()}
+    ingest_component("repo-a", docs=repo_docs, llm=llm, store=store, encoder=enc)
+    counts_2 = {t: store.count(t) for t in store.tables()}
+    assert counts_1 == counts_2, "re-ingest must upsert, not duplicate"
+
+
+def test_ingest_then_agent_answers(repo_docs):
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    ingest_llm = FakeLLM(script=INGEST_SCRIPT)
+    ingest_component("coderag-tpu", docs=repo_docs, llm=ingest_llm, store=store, encoder=enc)
+
+    agent_llm = FakeLLM(script={
+        r"Pick the retrieval scope": '{"scope": "chunk", "filters": {}}',
+        r"Assess whether the retrieved": '{"coverage": 0.9, "needs_more": false}',
+        r"senior engineer": "The engine schedules paged decode steps [1][2].",
+    })
+    agent = GraphAgent(agent_llm, RetrieverFactory(store, enc), namespace="default")
+    res = agent.run("how does the serving engine schedule decode steps?")
+    assert res.sources, "agent must retrieve ingested chunks"
+    assert "paged decode" in res.answer
+    # sources carry real file paths from this repo
+    assert any(s["file_path"].endswith(".py") for s in res.sources)
+
+
+def test_ingest_many_writes_sentinel(tmp_path, monkeypatch):
+    monkeypatch.setenv("DATA_DIR", str(tmp_path))
+    from githubrepostorag_tpu.config import reload_settings
+
+    reload_settings()
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    llm = FakeLLM(script=INGEST_SCRIPT)
+    # inject docs by monkeypatching the loader so no network is touched
+    docs = [SourceDoc("src/x.py", "def x():\n    return 1\n")]
+    import githubrepostorag_tpu.ingest.controller as ctl
+
+    monkeypatch.setattr(
+        "githubrepostorag_tpu.ingest.sources.GithubService.load_repo_documents",
+        lambda self, repo, branch=None: docs,
+    )
+    results = ingest_many(components=["one", "two"], llm=llm, store=store, encoder=enc)
+    assert len(results) == 2
+    assert all("error" not in r for r in results)
+    sentinel = json.loads((tmp_path / ".ingest_complete").read_text())
+    assert sentinel["repos"] == 2
+
+
+def test_ingest_many_isolates_per_repo_failures(monkeypatch):
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    llm = FakeLLM(script=INGEST_SCRIPT)
+
+    def load(self, repo, branch=None):
+        if repo == "bad":
+            raise RuntimeError("clone exploded")
+        return [SourceDoc("a.py", "def a():\n    pass\n")]
+
+    monkeypatch.setattr(
+        "githubrepostorag_tpu.ingest.sources.GithubService.load_repo_documents", load
+    )
+    results = ingest_many(components=["bad", "good"], llm=llm, store=store, encoder=enc)
+    assert "error" in results[0]
+    assert "error" not in results[1]
+
+
+def test_cli_local_ingest(tmp_path, monkeypatch, capsys):
+    src = tmp_path / "proj"
+    src.mkdir()
+    (src / "main.py").write_text("def main():\n    print('hello')\n")
+    (src / "README.md").write_text("# Proj\nA thing that does things for people.")
+    monkeypatch.setenv("DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.setenv("LLM_BACKEND", "fake")
+    from githubrepostorag_tpu.config import reload_settings
+
+    reload_settings()
+    from githubrepostorag_tpu.ingest.__main__ import main
+
+    rc = main(["--local", str(src), "--repo", "proj"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["repo"] == "proj"
+    assert out["written"]["chunk"] >= 1

@@ -1,0 +1,187 @@
+"""Ingest unit coverage: skip-lists, notebook cleaning, chunking, metadata
+sanitization, extractor isolation."""
+
+import json
+
+import pytest
+
+from githubrepostorag_tpu.ingest.chunker import split_code, split_document, split_text
+from githubrepostorag_tpu.ingest.extractors import enrich_nodes
+from githubrepostorag_tpu.ingest.notebook import process_notebook_content
+from githubrepostorag_tpu.ingest.preprocess import (
+    detect_language,
+    infer_component_kind,
+    prepare_repo_documents,
+    should_skip,
+)
+from githubrepostorag_tpu.ingest.types import Node, SourceDoc
+from githubrepostorag_tpu.ingest.vector_write import sanitize_metadata
+from githubrepostorag_tpu.llm import FakeLLM
+
+
+# ---- preprocess ----------------------------------------------------------
+
+def test_skip_lists():
+    assert should_skip("logo.png")
+    assert should_skip("package-lock.json")
+    assert should_skip("LICENSE")
+    assert should_skip("deep/dir/CHANGELOG.md")
+    assert not should_skip("src/main.py")
+    assert should_skip("data.bin", text="\x00\x01\x02")
+    assert should_skip("huge.js", text="x" * 500_000)
+
+
+def test_language_detection():
+    assert detect_language("a/b/c.py") == "python"
+    assert detect_language("Dockerfile") == "dockerfile"
+    assert detect_language("docker-compose.yaml") == "yaml"
+    assert detect_language("x.tsx") == "typescript"
+    assert detect_language("noext") is None
+
+
+def test_component_kind_heuristic():
+    nb = SourceDoc("analysis.ipynb", "{}")
+    df = SourceDoc("Dockerfile", "FROM python")
+    assert infer_component_kind([nb]) == "standalone"
+    assert infer_component_kind([nb, df]) == "service"
+    assert infer_component_kind([nb, df], dev_force_standalone=True) == "standalone"
+
+
+def test_prepare_tags_and_filters():
+    docs = [
+        SourceDoc("src/app.py", "print('hi')"),
+        SourceDoc("img.png", "\x89PNG"),
+        SourceDoc("empty.py", "   "),
+    ]
+    out = prepare_repo_documents(docs)
+    assert [d.path for d in out] == ["src/app.py"]
+    assert out[0].metadata["language"] == "python"
+    assert out[0].metadata["component_kind"] == "service"
+
+
+# ---- notebook ------------------------------------------------------------
+
+def _nb(cells):
+    return json.dumps({"cells": cells, "nbformat": 4})
+
+
+def test_notebook_keeps_code_drops_setup_and_logs():
+    cells = [
+        {"cell_type": "markdown", "source": "# Analysis"},
+        {"cell_type": "code", "source": "!pip install pandas", "outputs": []},
+        {"cell_type": "code", "source": "df = load()\ndf.head()", "outputs": [
+            {"output_type": "stream", "text": "2024-01-01 10:00:00 INFO loading\n" * 30},
+        ]},
+        {"cell_type": "code", "source": "print(df.shape)", "outputs": [
+            {"output_type": "stream", "text": "(100, 5)"},
+        ]},
+    ]
+    out = process_notebook_content(_nb(cells))
+    assert "# Analysis" in out
+    assert "pip install" not in out
+    assert "df.head()" in out
+    assert "INFO loading" not in out  # log-heavy output dropped
+    assert "(100, 5)" in out  # meaningful output kept
+
+
+def test_notebook_garbage_raises():
+    with pytest.raises(ValueError):
+        process_notebook_content("not a notebook at all")
+
+
+# ---- chunker -------------------------------------------------------------
+
+def test_split_code_python_boundaries():
+    src = "\n".join(
+        f"def fn_{i}():\n" + "\n".join(f"    x = {j}" for j in range(30))
+        for i in range(12)
+    )
+    chunks = split_code(src, "python")
+    assert len(chunks) > 1
+    assert all(len(c.text.splitlines()) <= 200 for c in chunks)
+    assert all(len(c.text) <= 4000 for c in chunks)
+    # every chunk starts at a function boundary (no mid-function cuts for
+    # units that fit)
+    assert all(c.text.startswith("def fn_") for c in chunks)
+    # spans reconstruct the file coverage
+    assert chunks[0].start_line == 1
+
+
+def test_split_code_oversized_unit_hard_splits_with_overlap():
+    src = "def big():\n" + "\n".join(f"    line_{i} = {i}" for i in range(500))
+    chunks = split_code(src, "python")
+    assert len(chunks) >= 3
+    # consecutive hard-split chunks overlap by ~10 lines
+    first_end = chunks[0].end_line
+    second_start = chunks[1].start_line
+    assert second_start <= first_end - 5
+
+
+def test_split_text_budget_and_overlap():
+    text = "\n\n".join(f"Paragraph {i}. " + "word " * 100 for i in range(10))
+    chunks = split_text(text, chunk_chars=1500, overlap_chars=100)
+    assert all(len(c.text) <= 1500 for c in chunks)
+    assert len(chunks) > 1
+
+
+def test_split_document_dispatch():
+    assert split_document("def x(): pass", "python")
+    assert split_document("# Title\n\nProse here.", "markdown")
+    assert split_document("", "python") == []
+
+
+# ---- sanitize ------------------------------------------------------------
+
+def test_sanitize_metadata_allow_list_and_flattening():
+    md = {
+        "scope": "chunk", "namespace": "default", "repo": "r", "module": "m",
+        "file_path": "a.py", "language": "python", "span": "1-10",
+        "keywords": ["a", "b"], "secret_internal": "drop me",
+        "rollup_of": ["x", "y"], "summary": None,
+    }
+    out = sanitize_metadata(md, "chunk")
+    assert out["keywords"] == "a, b"
+    assert "secret_internal" not in out
+    assert "rollup_of" not in out  # not allowed at chunk scope
+    assert "summary" not in out  # None dropped
+    assert all(isinstance(v, str) for v in out.values())
+
+    out_file = sanitize_metadata(md, "file")
+    assert out_file["rollup_of"] == "x, y"  # allowed at file scope
+
+
+# ---- extractors ----------------------------------------------------------
+
+def test_enrich_nodes_batched_and_isolated():
+    llm = FakeLLM(script={
+        r"Summarize": "Does a thing.",
+        r"title": "Thing Doer",
+        r"keywords": "alpha, beta, gamma",
+    })
+    nodes = [Node(text=f"def f{i}(): pass", metadata={}) for i in range(3)]
+    enrich_nodes(llm, nodes)
+    assert all(n.metadata["summary"] == "Does a thing." for n in nodes)
+    assert all(n.metadata["title"] == "Thing Doer" for n in nodes)
+    assert all(n.metadata["keywords"].startswith("alpha") for n in nodes)
+    assert all(n.metadata["topics"] == "alpha" for n in nodes)
+
+
+def test_enrich_survives_llm_explosion():
+    class BoomLLM:
+        def complete(self, *a, **k):
+            raise RuntimeError("boom")
+
+        def complete_batch(self, prompts, **k):
+            raise RuntimeError("boom")
+
+    nodes = [Node(text="x", metadata={})]
+    enrich_nodes(BoomLLM(), nodes)  # must not raise
+    assert "summary" not in nodes[0].metadata
+
+
+def test_stable_ids_are_deterministic():
+    n1 = Node(text="same", metadata={"scope": "chunk", "repo": "r", "span": "1-2"})
+    n2 = Node(text="same", metadata={"scope": "chunk", "repo": "r", "span": "1-2"})
+    n3 = Node(text="same", metadata={"scope": "chunk", "repo": "r", "span": "3-4"})
+    assert n1.stable_id() == n2.stable_id()
+    assert n1.stable_id() != n3.stable_id()
