@@ -2,7 +2,7 @@
 synthesize, rebuilt from the reference's LangGraph agent
 (rag_worker/src/worker/services/agent_graph.py) as a plain state machine."""
 
-from githubrepostorag_tpu.agent.graph import AgentResult, GraphAgent
+from githubrepostorag_tpu.agent.graph import AgentResult, GraphAgent, RunCancelled
 from githubrepostorag_tpu.agent.state import AgentState
 
-__all__ = ["GraphAgent", "AgentResult", "AgentState"]
+__all__ = ["GraphAgent", "AgentResult", "AgentState", "RunCancelled"]

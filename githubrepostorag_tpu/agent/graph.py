@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Callable
 
 from githubrepostorag_tpu.agent import prompts
 from githubrepostorag_tpu.agent.state import AgentState, ProgressCallback
@@ -85,6 +85,12 @@ def next_scope_down(scope: str) -> str:
     except ValueError:
         return "chunk"
     return SCOPE_LADDER[min(idx + 1, len(SCOPE_LADDER) - 1)]
+
+
+class RunCancelled(Exception):
+    """Raised between stages when the caller's should_stop probe fires
+    (cooperative cancellation — the reference only checked once before any
+    work, worker.py:121-124)."""
 
 
 @dataclass
@@ -340,20 +346,30 @@ class GraphAgent:
         namespace: str | None = None,
         progress_cb: ProgressCallback | None = None,
         force_level: str | None = None,
+        should_stop: Callable[[], bool] | None = None,
     ) -> AgentResult:
         state = AgentState(query=question, original_query=question, progress_cb=progress_cb)
         if namespace or self.namespace:
             state.filters["namespace"] = namespace or self.namespace
 
+        def check_cancel() -> None:
+            if should_stop is not None and should_stop():
+                raise RunCancelled()
+
+        check_cancel()
         # force_level honored (the reference read it but ignored it —
         # worker.py:101-107, SURVEY.md Appendix A) and skips the plan LLM call
         self.plan_scope(state, force_level=force_level)
 
         while True:
+            check_cancel()
             self.retrieve(state)
+            check_cancel()
             self.judge(state)
+            check_cancel()  # rewrite pays an LLM call; don't start it cancelled
             if self.rewrite_or_end(state) == "synthesize":
                 break
+        check_cancel()
         self.synthesize(state)
         return AgentResult(answer=state.answer or "", sources=state.sources, debug=state.debug)
 

@@ -1,0 +1,3 @@
+from githubrepostorag_tpu.api.app import RagApi, build_app
+
+__all__ = ["RagApi", "build_app"]

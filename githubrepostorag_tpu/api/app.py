@@ -1,0 +1,170 @@
+"""L5: the REST control plane (aiohttp).
+
+Same route surface as the reference's FastAPI app (rest_api/src/app/):
+  POST /rag/jobs                  -> {"job_id": ...} (uuid4 hex, enqueued)
+  GET  /rag/jobs/{id}/events      -> SSE stream from the progress bus
+  POST /rag/jobs/{id}/cancel      -> sets the cooperative cancel flag
+  GET  /rag/jobs/{id}/result      -> kept result (keep_result window)
+  GET  /health                    -> deep aggregate (503 when DOWN)
+  GET  /metrics                   -> Prometheus exposition
+  /static/index.html              -> chat UI
+with CORS and the per-request count/latency middleware
+(rest_api main.py:43-57).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from pathlib import Path
+
+from aiohttp import web
+
+from githubrepostorag_tpu.events.base import CancelFlags, JobQueue, ProgressBus
+from githubrepostorag_tpu.metrics import HTTP_LATENCY, HTTP_REQUESTS, render
+from githubrepostorag_tpu.models_dto import QueryRequest
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_STATIC_DIR = Path(__file__).resolve().parent / "static"
+
+
+@web.middleware
+async def _metrics_middleware(request: web.Request, handler):
+    start = time.monotonic()
+    status = 500
+    try:
+        response = await handler(request)
+        status = response.status
+        return response
+    except web.HTTPException as exc:
+        status = exc.status
+        raise
+    finally:
+        resource = request.match_info.route.resource if request.match_info.route else None
+        path = resource.canonical if resource else request.path
+        HTTP_REQUESTS.labels(request.method, path, str(status)).inc()
+        HTTP_LATENCY.labels(request.method, path).observe(time.monotonic() - start)
+
+
+@web.middleware
+async def _cors_middleware(request: web.Request, handler):
+    if request.method == "OPTIONS":
+        response = web.Response(status=204)
+    else:
+        response = await handler(request)
+    response.headers["Access-Control-Allow-Origin"] = "*"
+    response.headers["Access-Control-Allow-Methods"] = "GET, POST, OPTIONS"
+    response.headers["Access-Control-Allow-Headers"] = "Content-Type"
+    return response
+
+
+class RagApi:
+    def __init__(self, bus: ProgressBus, flags: CancelFlags, queue: JobQueue) -> None:
+        self.bus = bus
+        self.flags = flags
+        self.queue = queue
+        self._runner: web.AppRunner | None = None
+
+    def make_app(self) -> web.Application:
+        app = web.Application(middlewares=[_cors_middleware, _metrics_middleware])
+        app.router.add_post("/rag/jobs", self.create_job)
+        app.router.add_get("/rag/jobs/{job_id}/events", self.job_events)
+        app.router.add_post("/rag/jobs/{job_id}/cancel", self.cancel_job)
+        app.router.add_get("/rag/jobs/{job_id}/result", self.job_result)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/", self.index_redirect)
+        if _STATIC_DIR.is_dir():
+            app.router.add_static("/static/", _STATIC_DIR)
+        return app
+
+    async def start(self, host: str = "0.0.0.0", port: int = 8080) -> int:
+        self._runner = web.AppRunner(self.make_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        bound = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        logger.info("RAG API on %s:%d", host, bound)
+        return bound
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ------------------------------------------------------------ handlers
+
+    async def create_job(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            req = QueryRequest(**body)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response({"error": f"invalid request: {exc}"}, status=400)
+        job_id = uuid.uuid4().hex
+        await self.queue.enqueue_job("run_rag_job", job_id, req.model_dump(), _job_id=job_id)
+        return web.json_response({"job_id": job_id})
+
+    async def job_events(self, request: web.Request) -> web.StreamResponse:
+        job_id = request.match_info["job_id"]
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        try:
+            async for frame in self.bus.stream(job_id):
+                await resp.write(frame.encode())
+                # close the stream after the terminal event so EventSource
+                # clients do not reconnect forever
+                if '"event": "final"' in frame or '"event": "error"' in frame:
+                    break
+        except (ConnectionError, OSError):
+            pass
+        return resp
+
+    async def cancel_job(self, request: web.Request) -> web.Response:
+        job_id = request.match_info["job_id"]
+        await self.flags.cancel(job_id)
+        return web.json_response({"job_id": job_id, "cancelled": True})
+
+    async def job_result(self, request: web.Request) -> web.Response:
+        job_id = request.match_info["job_id"]
+        result = await self.queue.get_result(job_id)
+        if result is None:
+            return web.json_response({"error": "no result (pending, expired, or unknown)"}, status=404)
+        return web.json_response(result)
+
+    async def health(self, request: web.Request) -> web.Response:
+        import asyncio
+
+        from githubrepostorag_tpu.api.health import health_report
+
+        # health probes do blocking I/O (HTTP to the LLM backend, store
+        # connectivity); keep them off the event loop so SSE streams and
+        # enqueues never stall behind a slow probe
+        payload, status = await asyncio.get_running_loop().run_in_executor(None, health_report)
+        return web.json_response(payload, status=status)
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=render(), content_type="text/plain")
+
+    async def index_redirect(self, request: web.Request) -> web.Response:
+        raise web.HTTPFound("/static/index.html")
+
+
+def build_app(bus=None, flags=None, queue=None) -> RagApi:
+    """Default wiring: in-memory bus/flags/queue for single-pod mode; pass
+    Redis implementations for split deployments."""
+    from githubrepostorag_tpu.events import MemoryBus, MemoryCancelFlags, MemoryJobQueue
+
+    return RagApi(
+        bus=bus or MemoryBus(),
+        flags=flags or MemoryCancelFlags(),
+        queue=queue or MemoryJobQueue(),
+    )

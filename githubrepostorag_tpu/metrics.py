@@ -1,0 +1,91 @@
+"""Prometheus metrics shared across the API, worker, and serving engine.
+
+Mirrors the reference's three patterns (SURVEY.md §5.5): pull on the API
+(request count/latency middleware + /metrics — rest_api main.py:21-62),
+pull on the worker (job/LLM/retrieval counters — worker.py:36-47), push
+from the batch ingest job (ingest/controller.py handles that side).  Adds
+the serving metrics BASELINE needs: TTFT and decode-throughput histograms.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+REGISTRY = CollectorRegistry()
+
+HTTP_REQUESTS = Counter(
+    "rag_api_requests_total", "API requests", ["method", "path", "status"], registry=REGISTRY
+)
+HTTP_LATENCY = Histogram(
+    "rag_api_request_seconds", "API request latency", ["method", "path"], registry=REGISTRY
+)
+JOBS_TOTAL = Counter(
+    "rag_jobs_total", "RAG jobs processed", ["status"], registry=REGISTRY
+)
+JOB_DURATION = Histogram(
+    "rag_job_seconds", "RAG job wall-clock", registry=REGISTRY,
+    buckets=(0.5, 1, 2, 5, 10, 30, 60, 120, 300),
+)
+LLM_CALLS = Counter("rag_llm_calls_total", "LLM completions", ["status"], registry=REGISTRY)
+LLM_LATENCY = Histogram("rag_llm_call_seconds", "LLM completion latency", registry=REGISTRY)
+RETRIEVAL_HITS = Histogram(
+    "rag_retrieval_hits", "Docs returned per retrieval", registry=REGISTRY,
+    buckets=(0, 1, 2, 3, 5, 8, 10, 20),
+)
+TTFT = Histogram(
+    "rag_ttft_seconds", "Time to first generated token", registry=REGISTRY,
+    buckets=(0.1, 0.25, 0.5, 1.0, 1.5, 2.0, 3.0, 5.0, 10.0),
+)
+DECODE_TOKENS = Counter("rag_decode_tokens_total", "Generated tokens", registry=REGISTRY)
+ENGINE_RUNNING = Gauge("rag_engine_running_seqs", "Sequences in the decode batch", registry=REGISTRY)
+ENGINE_WAITING = Gauge("rag_engine_waiting_seqs", "Queued requests", registry=REGISTRY)
+
+
+def render() -> bytes:
+    return generate_latest(REGISTRY)
+
+
+class MeteredLLM:
+    """LLM wrapper recording call counts + latency (worker.py:73-88)."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+
+    def complete(self, prompt, **kw) -> str:
+        start = time.monotonic()
+        text = self._inner.complete(prompt, **kw)
+        LLM_LATENCY.observe(time.monotonic() - start)
+        LLM_CALLS.labels(status="error" if text.startswith("Error:") else "ok").inc()
+        return text
+
+    def complete_batch(self, prompts, **kw) -> list[str]:
+        batch = getattr(self._inner, "complete_batch", None)
+        start = time.monotonic()
+        if callable(batch):
+            out = batch(prompts, **kw)
+        else:
+            out = [self._inner.complete(p, **kw) for p in prompts]
+        LLM_LATENCY.observe(time.monotonic() - start)
+        for text in out:
+            LLM_CALLS.labels(status="error" if text.startswith("Error:") else "ok").inc()
+        return out
+
+    def stream_complete(self, prompt, **kw) -> Iterator[str]:
+        start = time.monotonic()
+        first = True
+        for delta in self._inner.stream_complete(prompt, **kw):
+            if first:
+                TTFT.observe(time.monotonic() - start)
+                first = False
+            yield delta
+        LLM_LATENCY.observe(time.monotonic() - start)
+        LLM_CALLS.labels(status="ok").inc()

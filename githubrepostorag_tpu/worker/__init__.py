@@ -1,0 +1,3 @@
+from githubrepostorag_tpu.worker.worker import RagWorker
+
+__all__ = ["RagWorker"]

@@ -1,0 +1,172 @@
+"""The RAG job worker: consumes ``run_rag_job`` jobs from the queue, drives
+the agent in a thread, streams progress to the bus, supports cooperative
+cancellation.
+
+Rebuild of rag_worker/src/worker/worker.py with its gaps fixed:
+  - cancellation is checked *between agent stages* via a should_stop probe
+    (the reference checked once before work, worker.py:121-124)
+  - the progress callback is per-job, bridged thread->loop with
+    run_coroutine_threadsafe exactly like the reference (worker.py:55-70)
+  - max_jobs concurrency (10), per-job timeout (300 s), results kept 3600 s
+    (WorkerSettings, worker.py:182-187)
+Event sequence per job: started -> iteration -> turn* -> retrieval ->
+final (or error + empty final).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any
+
+from githubrepostorag_tpu.agent import GraphAgent, RunCancelled
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.events.base import CancelFlags, EnqueuedJob, JobQueue, ProgressBus
+from githubrepostorag_tpu.metrics import JOB_DURATION, JOBS_TOTAL, RETRIEVAL_HITS
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class RagWorker:
+    def __init__(
+        self,
+        agent: GraphAgent,
+        bus: ProgressBus,
+        flags: CancelFlags,
+        queue: JobQueue,
+        max_jobs: int | None = None,
+        job_timeout: int | None = None,
+    ) -> None:
+        s = get_settings()
+        self.agent = agent
+        self.bus = bus
+        self.flags = flags
+        self.queue = queue
+        self.max_jobs = max_jobs or s.worker_max_jobs
+        self.job_timeout = job_timeout or s.job_timeout_seconds
+        self._sem = asyncio.Semaphore(self.max_jobs)
+        self._stopping = False
+        self._tasks: set[asyncio.Task] = set()  # strong refs: loop holds tasks weakly
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def run_forever(self) -> None:
+        logger.info("worker: consuming jobs (max_jobs=%d)", self.max_jobs)
+        while not self._stopping:
+            job = await self.queue.dequeue()
+            await self._sem.acquire()
+            task = asyncio.create_task(self._run_with_limit(job))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    def stop(self) -> None:
+        self._stopping = True
+
+    async def _run_with_limit(self, job: EnqueuedJob) -> None:
+        try:
+            if job.function != "run_rag_job":
+                logger.warning("unknown job function %r", job.function)
+                return
+            await asyncio.wait_for(self.run_rag_job(job), timeout=self.job_timeout)
+        except asyncio.TimeoutError:
+            JOBS_TOTAL.labels(status="timeout").inc()
+            await self._terminal(job.job_id, error=f"job timed out after {self.job_timeout}s")
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("job %s crashed", job.job_id)
+            JOBS_TOTAL.labels(status="error").inc()
+            await self._terminal(job.job_id, error=str(exc))
+        finally:
+            self._sem.release()
+
+    async def _terminal(self, job_id: str, error: str) -> None:
+        """Emit the error+empty-final pair AND store a terminal result so
+        polling clients can distinguish failed from pending."""
+        await self._safe_emit(job_id, "error", {"error": error})
+        await self._safe_emit(job_id, "final", {"answer": "", "sources": []})
+        try:
+            await self.queue.set_result(job_id, {"answer": "", "sources": [], "error": error})
+        except Exception:  # noqa: BLE001
+            logger.exception("set_result failed for %s", job_id)
+
+    # ------------------------------------------------------------ the job
+
+    async def run_rag_job(self, job: EnqueuedJob) -> dict[str, Any]:
+        job_id = job.job_id
+        req: dict[str, Any] = job.args[1] if len(job.args) > 1 else (job.args[0] if job.args else {})
+        if not isinstance(req, dict):
+            req = {}
+        query = req.get("query", "")
+        namespace = req.get("namespace") or get_settings().default_namespace
+        force_level = req.get("force_level")
+        start = time.monotonic()
+
+        await self.bus.emit(job_id, "started", {"job_id": job_id, "query": query})
+
+        if await self.flags.is_cancelled(job_id):
+            await self.bus.emit(job_id, "final", {"answer": "", "sources": [], "cancelled": True})
+            await self.queue.set_result(job_id, {"answer": "", "sources": [], "cancelled": True})
+            JOBS_TOTAL.labels(status="cancelled").inc()
+            return {"cancelled": True}
+
+        await self.bus.emit(job_id, "iteration", {"n": 1})
+
+        loop = asyncio.get_running_loop()
+        cancelled = threading.Event()
+
+        async def poll_cancel() -> None:
+            while not cancelled.is_set():
+                if await self.flags.is_cancelled(job_id):
+                    cancelled.set()
+                    return
+                await asyncio.sleep(0.5)
+
+        poller = asyncio.create_task(poll_cancel())
+
+        def progress_cb(event: dict) -> None:
+            # thread -> loop hop, the one crossing (worker.py:55-70)
+            asyncio.run_coroutine_threadsafe(
+                self._safe_emit(job_id, "turn", event), loop
+            )
+
+        try:
+            result = await loop.run_in_executor(
+                None,
+                lambda: self.agent.run(
+                    query, namespace=namespace, progress_cb=progress_cb,
+                    force_level=force_level, should_stop=cancelled.is_set,
+                ),
+            )
+        except RunCancelled:
+            await self.bus.emit(job_id, "final", {"answer": "", "sources": [], "cancelled": True})
+            await self.queue.set_result(job_id, {"answer": "", "sources": [], "cancelled": True})
+            JOBS_TOTAL.labels(status="cancelled").inc()
+            return {"cancelled": True}
+        finally:
+            cancelled.set()
+            poller.cancel()
+
+        debug = result.debug or {}
+        RETRIEVAL_HITS.observe(len(result.sources))
+        await self.bus.emit(
+            job_id,
+            "retrieval",
+            {
+                "scope": debug.get("final_scope", ""),
+                "sources_found": len(result.sources),
+                "turns": debug.get("turns", []),
+                "final_ctx_blocks": debug.get("final_ctx_blocks", 0),
+            },
+        )
+        await self.bus.emit(job_id, "final", {"answer": result.answer, "sources": result.sources})
+        JOBS_TOTAL.labels(status="ok").inc()
+        JOB_DURATION.observe(time.monotonic() - start)
+        await self.queue.set_result(job_id, {"answer": result.answer, "sources": result.sources})
+        return {"answer": result.answer}
+
+    async def _safe_emit(self, job_id: str, event: str, data: dict) -> None:
+        try:
+            await self.bus.emit(job_id, event, data)
+        except Exception:  # noqa: BLE001 - the bus must not kill the job
+            logger.exception("emit %s failed for %s", event, job_id)

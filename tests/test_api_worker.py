@@ -1,0 +1,211 @@
+"""API + worker end-to-end over a real TCP socket: job lifecycle, SSE event
+sequence, cancellation mid-run, health matrix, metrics exposure."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from githubrepostorag_tpu.agent import GraphAgent
+from githubrepostorag_tpu.api.app import RagApi
+from githubrepostorag_tpu.embedding import HashingTextEncoder
+from githubrepostorag_tpu.events import MemoryBus, MemoryCancelFlags, MemoryJobQueue
+from githubrepostorag_tpu.llm import FakeLLM
+from githubrepostorag_tpu.retrieval import RetrieverFactory
+from githubrepostorag_tpu.store import Doc, MemoryVectorStore
+from githubrepostorag_tpu.worker import RagWorker
+
+AGENT_SCRIPT = {
+    r"Pick the retrieval scope": '{"scope": "chunk", "filters": {}}',
+    r"Assess whether the retrieved": '{"coverage": 0.9, "needs_more": false}',
+    r"senior engineer": "Jobs are created via POST /rag/jobs [1].",
+}
+
+
+def _stack(script=None, slow_llm=None):
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    texts = [
+        ("c1", "async def create_job(request): enqueue and return job id",
+         {"repo": "api", "module": "app", "file_path": "app/jobs.py"}),
+        ("c2", "class RagWorker: consumes jobs and emits progress events",
+         {"repo": "api", "module": "worker", "file_path": "worker/worker.py"}),
+        ("c3", "def health_report(): aggregate store and llm probes",
+         {"repo": "api", "module": "app", "file_path": "app/health.py"}),
+    ]
+    store.upsert("embeddings", [
+        Doc(d, t, {"namespace": "default", "scope": "chunk", **m}, enc.encode([t])[0])
+        for d, t, m in texts
+    ])
+    llm = slow_llm or FakeLLM(script=script or AGENT_SCRIPT)
+    agent = GraphAgent(llm, RetrieverFactory(store, enc), namespace="default")
+    bus = MemoryBus(ping_interval=0.05)
+    flags, queue = MemoryCancelFlags(), MemoryJobQueue()
+    worker = RagWorker(agent, bus, flags, queue, max_jobs=4, job_timeout=30)
+    api = RagApi(bus, flags, queue)
+    return api, worker
+
+
+async def _with_service(fn, **kw):
+    import aiohttp
+
+    api, worker = _stack(**kw)
+    port = await api.start(host="127.0.0.1", port=0)
+    worker_task = asyncio.create_task(worker.run_forever())
+    try:
+        async with aiohttp.ClientSession() as session:
+            await fn(session, f"http://127.0.0.1:{port}", api, worker)
+    finally:
+        worker.stop()
+        worker_task.cancel()
+        await api.stop()
+
+
+async def _collect_events(session, base, job_id, timeout=15):
+    events = []
+    async with session.get(f"{base}/rag/jobs/{job_id}/events",
+                           timeout=__import__("aiohttp").ClientTimeout(total=timeout)) as resp:
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                events.append(json.loads(line[6:]))
+                if events[-1]["event"] in ("final",):
+                    break
+    return events
+
+
+async def test_job_lifecycle_and_event_sequence():
+    async def body(session, base, api, worker):
+        resp = await session.post(f"{base}/rag/jobs", json={"query": "how are jobs created?"})
+        assert resp.status == 200
+        job_id = (await resp.json())["job_id"]
+        assert len(job_id) == 32  # uuid4 hex
+
+        events = await _collect_events(session, base, job_id)
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "started"
+        assert "iteration" in kinds
+        assert "turn" in kinds  # agent breadcrumbs streamed
+        assert "retrieval" in kinds
+        assert kinds[-1] == "final"
+        final = events[-1]["data"]
+        assert "POST /rag/jobs" in final["answer"]
+        assert final["sources"]
+        retrieval = next(e for e in events if e["event"] == "retrieval")
+        assert retrieval["data"]["sources_found"] >= 1
+        assert retrieval["data"]["turns"]
+
+        # kept result retrievable afterwards
+        res = await session.get(f"{base}/rag/jobs/{job_id}/result")
+        assert res.status == 200
+        assert (await res.json())["answer"] == final["answer"]
+
+    await _with_service(body)
+
+
+async def test_cancel_mid_run():
+    class SlowLLM(FakeLLM):
+        def complete(self, prompt, **kw):
+            import time
+
+            time.sleep(0.3)
+            return super().complete(prompt, **kw)
+
+    slow = SlowLLM(script={
+        r"Pick the retrieval scope": '{"scope": "chunk", "filters": {}}',
+        r"Assess whether the retrieved": '{"coverage": 0.2, "needs_more": true}',
+        r"Rephrase": "retry query",
+        r"alternative search": '["alt"]',
+        r"senior engineer": "should never get here",
+    })
+
+    async def body(session, base, api, worker):
+        resp = await session.post(f"{base}/rag/jobs", json={"query": "slow question"})
+        job_id = (await resp.json())["job_id"]
+        await asyncio.sleep(0.5)  # let it get into the loop
+        cancel = await session.post(f"{base}/rag/jobs/{job_id}/cancel")
+        assert (await cancel.json())["cancelled"] is True
+        events = await _collect_events(session, base, job_id)
+        final = events[-1]
+        assert final["event"] == "final"
+        assert final["data"].get("cancelled") is True
+        assert final["data"]["answer"] == ""
+
+    await _with_service(slow_llm=slow, fn=body)
+
+
+async def test_bad_request_400():
+    async def body(session, base, api, worker):
+        resp = await session.post(f"{base}/rag/jobs", data=b"nope")
+        assert resp.status == 400
+
+    await _with_service(body)
+
+
+async def test_health_and_metrics():
+    async def body(session, base, api, worker):
+        health = await session.get(f"{base}/health")
+        assert health.status == 200
+        payload = await health.json()
+        assert payload["status"] == "UP"
+        assert payload["components"]["vectorStore"]["status"] == "UP"
+        assert "uptime" in payload["components"]["system"]["details"]
+
+        # generate some traffic then check metrics exposition
+        await session.post(f"{base}/rag/jobs", json={"query": "q"})
+        metrics = await (await session.get(f"{base}/metrics")).text()
+        assert "rag_api_requests_total" in metrics
+        assert "rag_jobs_total" in metrics
+
+    await _with_service(body)
+
+
+async def test_health_503_when_store_breaks(monkeypatch):
+    async def body(session, base, api, worker):
+        class BrokenStore:
+            def health(self):
+                return {"status": "DOWN", "error": "no contact points"}
+
+        import githubrepostorag_tpu.store.factory as factory
+
+        monkeypatch.setattr(factory, "_store", BrokenStore())
+        resp = await session.get(f"{base}/health")
+        assert resp.status == 503
+        assert (await resp.json())["status"] == "DOWN"
+
+    await _with_service(body)
+
+
+async def test_static_ui_served():
+    async def body(session, base, api, worker):
+        resp = await session.get(f"{base}/static/index.html")
+        assert resp.status == 200
+        html = await resp.text()
+        assert "EventSource" in html
+        assert "/rag/jobs" in html
+        root = await session.get(f"{base}/")
+        assert root.status == 200  # redirect followed to the UI
+
+    await _with_service(body)
+
+
+async def test_concurrent_jobs():
+    async def body(session, base, api, worker):
+        ids = []
+        for i in range(4):
+            resp = await session.post(f"{base}/rag/jobs", json={"query": f"question {i}"})
+            ids.append((await resp.json())["job_id"])
+        results = await asyncio.gather(*(_collect_events(session, base, j) for j in ids))
+        for events in results:
+            assert events[-1]["event"] == "final"
+            assert events[-1]["data"]["answer"]
+
+    await _with_service(body)
+
+
+def test_format_uptime():
+    from githubrepostorag_tpu.api.health import format_uptime
+
+    assert format_uptime(5) == "5s"
+    assert format_uptime(3665) == "1h 1m 5s"
+    assert format_uptime(90061) == "1d 1h 1m 1s"
