@@ -14,6 +14,7 @@ with CORS and the per-request count/latency middleware
 
 from __future__ import annotations
 
+import concurrent.futures
 import time
 import uuid
 from pathlib import Path
@@ -29,6 +30,13 @@ logger = get_logger(__name__)
 
 _STATIC_DIR = Path(__file__).resolve().parent / "static"
 
+# health probes get their own pool: the default executor is shared with
+# agent jobs (worker.py run_in_executor), so a busy pod would otherwise
+# queue liveness probes behind minutes of RAG work and get itself killed
+_HEALTH_POOL = concurrent.futures.ThreadPoolExecutor(
+    max_workers=2, thread_name_prefix="health-probe"
+)
+
 
 @web.middleware
 async def _metrics_middleware(request: web.Request, handler):
@@ -43,7 +51,8 @@ async def _metrics_middleware(request: web.Request, handler):
         raise
     finally:
         resource = request.match_info.route.resource if request.match_info.route else None
-        path = resource.canonical if resource else request.path
+        # unmatched routes (404 scans) must not mint unbounded label values
+        path = resource.canonical if resource else "unmatched"
         HTTP_REQUESTS.labels(request.method, path, str(status)).inc()
         HTTP_LATENCY.labels(request.method, path).observe(time.monotonic() - start)
 
@@ -81,6 +90,10 @@ class RagApi:
         return app
 
     async def start(self, host: str = "0.0.0.0", port: int = 8080) -> int:
+        # import now so the health module's uptime clock starts with the
+        # server, not with the first probe request
+        from githubrepostorag_tpu.api import health  # noqa: F401
+
         self._runner = web.AppRunner(self.make_app())
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -148,7 +161,9 @@ class RagApi:
         # health probes do blocking I/O (HTTP to the LLM backend, store
         # connectivity); keep them off the event loop so SSE streams and
         # enqueues never stall behind a slow probe
-        payload, status = await asyncio.get_running_loop().run_in_executor(None, health_report)
+        payload, status = await asyncio.get_running_loop().run_in_executor(
+            _HEALTH_POOL, health_report
+        )
         return web.json_response(payload, status=status)
 
     async def metrics(self, request: web.Request) -> web.Response:

@@ -213,6 +213,41 @@ def forward(
     return logits, None
 
 
+def forward_with_attend(
+    params: dict,
+    cfg: Qwen2Config,
+    input_ids: jnp.ndarray,  # [B, S] int32
+    positions: jnp.ndarray,  # [B, S] int32
+    attend_fn=None,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Cache-free forward with a pluggable attention implementation.
+
+    ``attend_fn(q, k, v) -> out`` defaults to causal dense attention; the
+    training path passes ``parallel.make_ring_attend(...)`` so the sequence
+    axis can live sharded over the ``sp`` mesh axis.  ``remat`` checkpoints
+    each scanned layer, so backward holds one layer's activations at a time
+    (peak HBM O(S) instead of O(S·L)).  Not jitted — callers jit (the train
+    step jits the whole loss+grad program).  Returns logits [B, S, V] f32.
+    """
+    if attend_fn is None:
+        attend_fn = lambda q, k, v: dense_attention(q, k, v, causal=True, q_offset=0)
+
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(h, layer_xs):
+        (p,) = layer_xs
+        h, _ = _block(cfg, h, p, cos, sin, lambda q, k, v: (attend_fn(q, k, v), None))
+        return h, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    h, _ = jax.lax.scan(body, h, (params["layers"],))
+    h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
+    return _logits(params, h)
+
+
 def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
     """Final projection in float32 (tied embedding or separate lm_head)."""
     lm_head = params.get("lm_head")

@@ -1,0 +1,54 @@
+"""Mesh / sharding / collectives — the model-level distributed fabric.
+
+The reference has no model-level parallelism at all (vLLM runs TP=1 on a
+single GPU, no ``--tensor-parallel-size`` in helm/templates/qwen-deployment.yaml:23-33;
+NCCL is present only transitively and unused — SURVEY.md §2.3).  The
+TPU-native build makes the mesh a first-class subsystem instead:
+
+  mesh.py           -- one ``jax.sharding.Mesh`` over the logical axes
+                       (dp, pp, tp, sp, ep); factorisation helpers.
+  sharding.py       -- PartitionSpec rules for the Qwen2 decoder and the
+                       BERT encoder params (Megatron-style column/row TP),
+                       with divisibility-checked fallback to replication,
+                       plus ``shard_params`` / batch-sharding helpers.
+  ring_attention.py -- sequence-parallel causal GQA attention: the sequence
+                       axis lives sharded over ``sp``; K/V blocks rotate
+                       around the ring via ``lax.ppermute`` while each step
+                       folds one block into an online (streaming) softmax.
+
+All collectives are either emitted by XLA/GSPMD from the sharding
+annotations (TP psum/all-gather around the row/column-parallel matmuls) or
+written once as ``ppermute`` inside ``shard_map`` (the ring).  Nothing here
+speaks NCCL/MPI — ICI/DCN routing is the compiler's job.
+
+PP and EP exist as mesh axes (size 1 by default) so pipeline/expert layouts
+can slot in without re-plumbing callers; Qwen2-7B on a v5e-8 fits with TP
+alone (SURVEY.md §2.3), so no pipeline schedule is implemented yet.
+"""
+
+from githubrepostorag_tpu.parallel.mesh import (
+    AXIS_NAMES,
+    MeshPlan,
+    make_mesh,
+    plan_for_devices,
+)
+from githubrepostorag_tpu.parallel.ring_attention import make_ring_attend, ring_attention
+from githubrepostorag_tpu.parallel.sharding import (
+    batch_spec,
+    encoder_param_specs,
+    qwen2_param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "AXIS_NAMES",
+    "MeshPlan",
+    "make_mesh",
+    "plan_for_devices",
+    "qwen2_param_specs",
+    "encoder_param_specs",
+    "shard_params",
+    "batch_spec",
+    "ring_attention",
+    "make_ring_attend",
+]

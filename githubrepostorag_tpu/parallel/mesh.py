@@ -1,0 +1,115 @@
+"""Device-mesh construction over the five logical parallelism axes.
+
+One mesh shape serves the whole framework: serving shards the decoder with
+``tp``, ingest batch-embedding uses ``dp``, long-context training/scoring
+spreads the sequence over ``sp`` (ring attention), and ``pp``/``ep`` are
+reserved axes (size 1 until a pipeline schedule / MoE family lands) so
+PartitionSpecs never need re-plumbing when they do.
+
+The reference has nothing to mirror here (single GPU, TP=1 — SURVEY.md
+§2.3); the design follows the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Order matters: earlier axes vary slowest over the device list.  ICI
+# neighbours come from trailing axes, so put the bandwidth-hungry axes
+# (tp, sp — per-layer collectives) last and the coarse-grained ones
+# (dp — gradient/batch reductions only) first.
+AXIS_NAMES = ("dp", "pp", "tp", "sp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.tp * self.sp * self.ep
+
+    def shape(self) -> dict[str, int]:
+        return {"dp": self.dp, "pp": self.pp, "tp": self.tp, "sp": self.sp, "ep": self.ep}
+
+
+def make_mesh(plan: MeshPlan | None = None, devices=None, **axes: int) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` for ``plan`` (or keyword axis sizes).
+
+    ``make_mesh(tp=4, dp=2)`` -> 8-device mesh with axes
+    (dp=2, pp=1, tp=4, sp=1, ep=1).  The axis-size product must equal the
+    number of devices used.
+    """
+    if plan is None:
+        plan = MeshPlan(**axes)
+    elif axes:
+        raise TypeError("pass either a MeshPlan or keyword axis sizes, not both")
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if plan.n_devices > len(devices):
+        raise ValueError(
+            f"mesh plan {plan.shape()} needs {plan.n_devices} devices, "
+            f"only {len(devices)} available"
+        )
+    devices = devices[: plan.n_devices]
+    grid = np.asarray(devices).reshape(plan.dp, plan.pp, plan.tp, plan.sp, plan.ep)
+    return Mesh(grid, AXIS_NAMES)
+
+
+def plan_for_devices(
+    n: int,
+    *,
+    num_heads: int | None = None,
+    num_kv_heads: int | None = None,
+    role: str = "serve",
+) -> MeshPlan:
+    """Factor ``n`` devices into a sensible default plan.
+
+    serve: all-TP (latency — every chip works on every token), capped at the
+    largest power-of-two divisor of ``num_heads`` (and kv heads if given, so
+    the attention shard_map specs divide cleanly); leftover devices become dp.
+    train: balance dp × tp × sp so batch, heads, and sequence all shard.
+    ingest: all-DP (throughput — independent batch rows).
+    """
+    if n < 1:
+        raise ValueError("need at least one device")
+
+    def tp_for(n: int) -> int:
+        # largest power of two that divides the device count AND every given
+        # head count — never strands devices, never splits a head
+        tp = _pow2_floor(n)
+        heads = [h for h in (num_heads, num_kv_heads) if h is not None]
+        while tp > 1 and not (n % tp == 0 and all(h % tp == 0 for h in heads)):
+            tp //= 2
+        return tp
+
+    if role == "ingest":
+        return MeshPlan(dp=n)
+    if role == "serve":
+        tp = tp_for(n)
+        return MeshPlan(dp=n // tp, tp=tp)
+    if role == "train":
+        # peel off tp first (bounded by heads), then split the rest between
+        # dp and sp as evenly as powers of two allow
+        tp = tp_for(n)
+        rest = n // tp
+        sp = _pow2_floor(int(rest**0.5))
+        while rest % sp != 0:
+            sp //= 2
+        return MeshPlan(dp=rest // sp, tp=tp, sp=sp)
+    raise ValueError(f"unknown role {role!r}")
+
+
+def _pow2_floor(x: int) -> int:
+    p = 1
+    while p * 2 <= x:
+        p *= 2
+    return p

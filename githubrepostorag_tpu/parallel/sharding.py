@@ -1,0 +1,95 @@
+"""PartitionSpec rules: how each param of the Qwen2 decoder / BERT encoder
+lays out over the mesh, plus helpers to apply them.
+
+Megatron-style tensor parallelism expressed purely as sharding annotations
+(GSPMD inserts the collectives):
+
+  - attention: wq/bq column-parallel over heads, wo row-parallel (psum after
+    the output projection); wk/wv shard only when tp divides the KV-head
+    count — Qwen2's GQA has 2-4 KV heads, so at tp > n_kv they stay
+    replicated (they are the small projections; this is the standard GQA
+    trade, not a fallback of convenience).
+  - MLP: wg/wu column-parallel over the intermediate dim, wd row-parallel.
+  - embedding vocab-parallel; untied lm_head vocab-parallel on its output.
+  - norms and other vectors replicated.
+
+Every rule is divisibility-checked against the actual mesh: a dimension that
+doesn't divide evenly is replicated rather than producing a GSPMD error, so
+the same code serves tp=1 tests and tp=8 pods.
+
+The reference ships nothing comparable (TP=1, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config
+
+
+def _axis(mesh: Mesh, name: str, dim: int) -> str | None:
+    """Use mesh axis ``name`` for a dimension of size ``dim`` iff it divides."""
+    size = mesh.shape.get(name, 1)
+    return name if size > 1 and dim % size == 0 else None
+
+
+def qwen2_param_specs(cfg: Qwen2Config, mesh: Mesh) -> dict:
+    """PartitionSpec pytree matching ``models.qwen2.init_params`` structure."""
+    nq, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    inter, d, v = cfg.intermediate_size, cfg.hidden_size, cfg.vocab_size
+
+    # shard the fused head dim only when tp divides the head *count*, so the
+    # [.., n, hd] reshape inside the block propagates without resharding
+    q_tp = _axis(mesh, "tp", nq) and _axis(mesh, "tp", nq * hd)
+    kv_tp = _axis(mesh, "tp", nkv) and _axis(mesh, "tp", nkv * hd)
+    mlp_tp = _axis(mesh, "tp", inter)
+    vocab_tp = _axis(mesh, "tp", v)
+
+    layers = {
+        "ln1": P(None, None),
+        "ln2": P(None, None),
+        "wq": P(None, None, q_tp),
+        "bq": P(None, q_tp),
+        "wk": P(None, None, kv_tp),
+        "bk": P(None, kv_tp),
+        "wv": P(None, None, kv_tp),
+        "bv": P(None, kv_tp),
+        "wo": P(None, q_tp, None),
+        "wg": P(None, None, mlp_tp),
+        "wu": P(None, None, mlp_tp),
+        "wd": P(None, mlp_tp, None),
+    }
+    specs = {
+        "embed": P(vocab_tp, None),
+        "layers": layers,
+        "norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, vocab_tp)
+    return specs
+
+
+def encoder_param_specs(params, mesh: Mesh) -> dict:
+    """The e5-small-class encoder is ~33M params — replicate everywhere and
+    scale by sharding the *batch* over dp (see ``batch_spec``)."""
+    del mesh
+    return jax.tree.map(lambda _: P(), params)
+
+
+def batch_spec(*, seq_parallel: bool = False) -> P:
+    """Sharding for [B, S] token batches: batch over dp, sequence over sp
+    when ring attention is in play."""
+    return P("dp", "sp" if seq_parallel else None)
+
+
+def shard_params(params, mesh: Mesh, specs) -> dict:
+    """Place a param pytree onto the mesh per ``specs`` (a PartitionSpec
+    pytree of the same structure)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

@@ -57,11 +57,23 @@ class AsyncEngine:
             self._thread = None
 
     def _drive(self) -> None:
+        from githubrepostorag_tpu.metrics import (
+            DECODE_TOKENS,
+            ENGINE_RUNNING,
+            ENGINE_WAITING,
+            TTFT,
+        )
+
         while not self._stop:
             with self._lock:
                 has_work = self.engine.has_work()
                 finished = self.engine.step() if has_work else []
+                ENGINE_RUNNING.set(self.engine.num_running)
+                ENGINE_WAITING.set(self.engine.num_waiting)
             for res in finished:
+                DECODE_TOKENS.inc(len(res.output_tokens))
+                if res.ttft_s is not None:
+                    TTFT.observe(res.ttft_s)
                 self._emit(res.request_id, StreamEvent(type="final", result=res))
             if not has_work:
                 self._wake.wait(timeout=0.02)

@@ -1,0 +1,18 @@
+"""Sharded training/fine-tuning over the device mesh.
+
+The reference never trains anything (weights come from the HF hub into
+vLLM); the TPU build carries an in-tree train step anyway because the mesh,
+sharding rules, and ring attention are shared infrastructure with serving —
+the same ``parallel`` annotations that TP-shard the decoder for generation
+shard its gradients here, and this is what the driver's multi-chip dry-run
+compiles (``__graft_entry__.dryrun_multichip``).
+"""
+
+from githubrepostorag_tpu.training.step import (
+    TrainState,
+    causal_lm_loss,
+    init_train_state,
+    make_train_step,
+)
+
+__all__ = ["TrainState", "causal_lm_loss", "init_train_state", "make_train_step"]

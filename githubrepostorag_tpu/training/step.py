@@ -1,0 +1,108 @@
+"""Causal-LM train step, jitted once over the whole mesh.
+
+Parallelism is annotation-driven (the scaling-book recipe): params carry the
+Megatron TP specs from ``parallel.sharding``, batches shard [B, S] over
+(dp, sp), and the one jitted program contains forward (+ ring attention when
+sp > 1), backward, and the optax update — XLA/GSPMD inserts every
+collective (TP psum, dp gradient reductions, sp ring ppermute) over ICI.
+
+Remat: the transformer blocks run under ``jax.checkpoint`` so backward
+recomputes activations instead of keeping S×L of them in HBM — the standard
+TPU memory/FLOPs trade for long sequences.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, forward_with_attend, init_params
+from githubrepostorag_tpu.parallel.ring_attention import make_ring_attend
+from githubrepostorag_tpu.parallel.sharding import batch_spec, qwen2_param_specs, shard_params
+
+
+@dataclass
+class TrainState:
+    params: dict
+    opt_state: Any
+    step: int = 0
+
+
+def causal_lm_loss(
+    logits: jnp.ndarray,  # [B, S, V] float32
+    targets: jnp.ndarray,  # [B, S] int32 (already shifted by the caller)
+    mask: jnp.ndarray,  # [B, S] 0/1 — padding and prompt masking
+) -> jnp.ndarray:
+    """Mean masked next-token cross-entropy (float32)."""
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    mask = mask.astype(jnp.float32)
+    return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_train_step(
+    cfg: Qwen2Config,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation | None = None,
+    *,
+    seq_parallel: bool | None = None,
+    remat: bool = True,
+) -> tuple[Callable, optax.GradientTransformation]:
+    """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    ``batch`` is a dict with int32 [B, S] ``input_ids``/``targets``/``mask``.
+    B must divide by mesh dp and S by mesh sp.  ``seq_parallel`` defaults to
+    sp > 1.  Returns (jitted step, the optimizer used).
+    """
+    optimizer = optimizer or optax.adamw(1e-4)
+    sp = mesh.shape.get("sp", 1)
+    if seq_parallel is None:
+        seq_parallel = sp > 1
+
+    attend = None
+    if seq_parallel and sp > 1:
+        attend = make_ring_attend(
+            mesh, num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads
+        )
+
+    data_sharding = NamedSharding(mesh, batch_spec(seq_parallel=seq_parallel))
+
+    def loss_fn(params, batch):
+        b, s = batch["input_ids"].shape
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        logits = forward_with_attend(
+            params, cfg, batch["input_ids"], positions, attend, remat=remat
+        )
+        return causal_lm_loss(logits, batch["targets"], batch["mask"])
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        batch = jax.lax.with_sharding_constraint(
+            batch, {k: data_sharding for k in batch}
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, optimizer
+
+
+def init_train_state(
+    cfg: Qwen2Config,
+    mesh: Mesh,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+    dtype=jnp.float32,
+) -> TrainState:
+    """Random-init params directly onto the mesh (TP specs) and an opt state
+    whose moment pytrees inherit the param shardings."""
+    specs = qwen2_param_specs(cfg, mesh)
+    params = shard_params(init_params(cfg, key, dtype=dtype), mesh, specs)
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(params=params, opt_state=opt_state)
