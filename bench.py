@@ -1,0 +1,111 @@
+"""Headline benchmark: continuous-batching decode throughput of the in-tree
+serving engine on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+
+The baseline denominator is the BASELINE.json north-star floor of
+2000 tok/s/chip (stated there for Qwen2-7B on v5e-8; the reference itself
+publishes no numbers — SURVEY.md §6).  This round benches the Qwen2-0.5B
+flagship geometry (eval config #1) with random bf16 weights — throughput is
+weight-value-independent.
+
+All progress goes to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_TOK_S = 2000.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    log(f"bench: platform={platform} devices={len(jax.devices())}")
+
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+    if on_tpu:
+        cfg = Qwen2Config.qwen2_0_5b()
+        batch, prompt_len, gen_tokens = 8, 128, 128
+        num_pages, page_size, max_seq = 1024, 16, 1024
+        model_tag = "qwen2-0.5b"
+    else:  # CPU fallback so the script still demonstrates end to end
+        cfg = Qwen2Config.tiny()
+        batch, prompt_len, gen_tokens = 4, 32, 16
+        num_pages, page_size, max_seq = 128, 16, 256
+        model_tag = "tiny"
+
+    log(f"bench: init {model_tag} params (bf16)")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    jax.block_until_ready(params)
+
+    def build_engine(use_pallas: bool) -> Engine:
+        return Engine(
+            params, cfg,
+            max_num_seqs=batch, num_pages=num_pages, page_size=page_size,
+            max_seq_len=max_seq, prefill_chunk=prompt_len, use_pallas=use_pallas,
+            decode_burst=32,
+        )
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist() for _ in range(batch)]
+    sp = SamplingParams(max_tokens=gen_tokens, temperature=0.7, stop_token_ids=())
+
+    def run(engine: Engine):
+        t0 = time.monotonic()
+        results = engine.generate(prompts, sp)
+        wall = time.monotonic() - t0
+        toks = sum(len(r.output_tokens) for r in results)
+        # decode throughput: tokens after each stream's first (prefill-paid) token
+        decode_t = max(max(r.decode_time_s for r in results), 1e-9)
+        decode_toks = sum(max(len(r.output_tokens) - 1, 0) for r in results)
+        ttfts = sorted(r.ttft_s for r in results if r.ttft_s is not None)
+        p50_ttft = ttfts[len(ttfts) // 2] if ttfts else float("nan")
+        return toks, wall, decode_toks / decode_t, p50_ttft
+
+    use_pallas = on_tpu
+    try:
+        engine = build_engine(use_pallas)
+        log("bench: warmup (compile)")
+        run(engine)  # compile + warm
+        engine = build_engine(use_pallas)
+        toks, wall, decode_tps, p50_ttft = run(engine)
+    except Exception as exc:  # pallas kernel unavailable on this backend
+        if not use_pallas:
+            raise
+        log(f"bench: pallas path failed ({exc!r}); falling back to XLA reference attention")
+        use_pallas = False
+        engine = build_engine(False)
+        run(engine)
+        engine = build_engine(False)
+        toks, wall, decode_tps, p50_ttft = run(engine)
+
+    log(
+        f"bench: {toks} tokens in {wall:.2f}s wall, decode {decode_tps:.1f} tok/s, "
+        f"p50 TTFT {p50_ttft:.3f}s, pallas={use_pallas}"
+    )
+    print(json.dumps({
+        "metric": f"decode_tok_s_per_chip_{model_tag}_bs{batch}",
+        "value": round(decode_tps, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(decode_tps / BASELINE_TOK_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

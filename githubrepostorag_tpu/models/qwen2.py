@@ -249,11 +249,18 @@ def forward_with_attend(
 
 
 def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
-    """Final projection in float32 (tied embedding or separate lm_head)."""
+    """Final projection -> float32 logits (tied embedding or separate
+    lm_head).  Operands stay in their stored dtype (bf16 on the MXU) with
+    float32 accumulation via preferred_element_type — an explicit astype
+    would materialize a second full-vocab matrix every decode step."""
     lm_head = params.get("lm_head")
     if lm_head is None:
-        return h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    return h.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+        return jnp.einsum(
+            "bsd,vd->bsv", h, params["embed"], preferred_element_type=jnp.float32
+        )
+    return jnp.einsum(
+        "bsd,dv->bsv", h, lm_head, preferred_element_type=jnp.float32
+    )
 
 
 def make_dense_cache(cfg: Qwen2Config, batch: int, max_len: int, dtype=jnp.bfloat16):
@@ -283,6 +290,28 @@ def forward_paged(
     table.  Returns (logits [B, S, V] float32, k_pages, v_pages) — the pools
     are donated so XLA updates them in place.
     """
+    return forward_paged_impl(
+        params, cfg, input_ids, positions, k_pages, v_pages,
+        slot_mapping, block_tables, cached_lens, new_lens, use_pallas,
+    )
+
+
+def forward_paged_impl(
+    params: dict,
+    cfg: Qwen2Config,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    slot_mapping: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    cached_lens: jnp.ndarray,
+    new_lens: jnp.ndarray,
+    use_pallas: bool = False,
+):
+    """Unjitted body of ``forward_paged`` so larger fused programs (the
+    multi-step decode burst in serving/decode_burst.py) can inline it inside
+    their own scan without nested-jit donation clashes."""
     from githubrepostorag_tpu.ops.paged_attention import paged_attention_ref
 
     if use_pallas:

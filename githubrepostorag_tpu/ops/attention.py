@@ -21,12 +21,15 @@ def dense_attention(
     causal: bool = True,
     q_offset: jnp.ndarray | int = 0,
     kv_lengths: jnp.ndarray | None = None,  # [B] valid kv length per seq
+    kv_valid: jnp.ndarray | None = None,  # [B, Sk] bool — arbitrary validity
 ) -> jnp.ndarray:
     """Scaled-dot-product attention with causal masking and GQA.
 
     ``q_offset`` is the absolute position of q's first token within the kv
     sequence (decode: Sk-1 for a single new token; chunked prefill: the chunk
-    start).  ``kv_lengths`` masks right-padded kv entries per batch row.
+    start).  ``kv_lengths`` masks right-padded kv entries per batch row;
+    ``kv_valid`` masks arbitrary kv entries (the decode burst's
+    pool-prefix + staged-tail layout, where validity isn't a prefix).
     Returns [B, Sq, n_q, hd] in q.dtype; softmax in float32.
     """
     b, sq, n_q, hd = q.shape
@@ -48,6 +51,8 @@ def dense_attention(
     if kv_lengths is not None:
         pad_mask = kv_pos[None, :] >= kv_lengths[:, None]  # [B, Sk]
         mask = mask | pad_mask[:, None, None, None, :]
+    if kv_valid is not None:
+        mask = mask | (~kv_valid)[:, None, None, None, :]
     scores = jnp.where(mask, NEG_INF, scores)
 
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))

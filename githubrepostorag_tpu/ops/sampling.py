@@ -58,6 +58,44 @@ def top_p_mask(logits: jnp.ndarray, p: jnp.ndarray | float) -> jnp.ndarray:
     return jnp.where(logits < threshold, NEG_INF, logits)
 
 
+def sample_tokens_capped(
+    logits: jnp.ndarray,  # [B, V] float32
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B] — 0 means greedy
+    top_p: jnp.ndarray,  # [B] — 1.0 disables
+    top_k: jnp.ndarray,  # [B] int32 — 0 disables
+    repetition_penalty: jnp.ndarray,  # [B]
+    presence: jnp.ndarray,  # [B, V] bool
+    cap: int = 128,
+) -> jnp.ndarray:
+    """Decode-loop sampler: identical semantics to ``sample_tokens`` except
+    top-k/top-p operate within the ``cap`` highest logits (``lax.top_k``
+    instead of two full vocab sorts — the sorts cost more than the whole
+    0.5B forward at decode time).  Exact whenever the nucleus fits in the
+    cap, which holds for every sampling config in the system (reference
+    clients use top_p 0.8/0.9 at temperature <= 0.7 — qwen_llm.py:107-114);
+    for pathological high-temperature requests the tail beyond the top
+    ``cap`` tokens is truncated."""
+    logits = apply_repetition_penalty(logits, presence, repetition_penalty[:, None])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    vals, idx = jax.lax.top_k(scaled, cap)  # [B, cap] descending
+    # top-k within the cap: positions >= k masked (k<=0 disables)
+    ranks = jnp.arange(cap)[None, :]
+    k_arr = top_k[:, None]
+    vals = jnp.where((k_arr > 0) & (ranks >= k_arr), NEG_INF, vals)
+    # nucleus within the cap (vals already sorted descending)
+    probs = jax.nn.softmax(vals, axis=-1)
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p[:, None]
+    keep = keep.at[:, 0].set(True)
+    vals = jnp.where(keep, vals, NEG_INF)
+    choice = jax.random.categorical(rng, vals, axis=-1)  # [B] index into cap
+    sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
 @partial(jax.jit, static_argnames=())
 def sample_tokens(
     logits: jnp.ndarray,  # [B, V] float32 (last-position logits)

@@ -96,6 +96,7 @@ class Engine:
         kv_dtype=jnp.bfloat16,
         use_pallas: bool = False,
         rng_seed: int = 0,
+        decode_burst: int = 8,
     ) -> None:
         self.params = params
         self.cfg = cfg
@@ -105,6 +106,9 @@ class Engine:
         self.max_pages_per_seq = pages_needed(max_seq_len, page_size)
         self.prefill_chunk = prefill_chunk
         self.use_pallas = use_pallas
+        # decode iterations fused per device dispatch (serving/decode_burst.py);
+        # 1 reproduces plain per-token stepping
+        self.decode_burst = max(1, decode_burst)
 
         pools = make_page_pools(cfg, num_pages, page_size, dtype=kv_dtype)
         self._k_pages, self._v_pages = pools.k, pools.v
@@ -113,6 +117,7 @@ class Engine:
         # host-side batch state
         self._block_tables = np.zeros((max_num_seqs, self.max_pages_per_seq), dtype=np.int32)
         self._seq_lens = np.zeros((max_num_seqs,), dtype=np.int32)
+        self._row_limits = np.zeros((max_num_seqs,), dtype=np.int32)  # page capacity per row
         self._free_rows = list(range(max_num_seqs - 1, -1, -1))
         self._row_req: dict[int, _Request] = {}
 
@@ -237,6 +242,9 @@ class Engine:
         self._row_req[row] = req
         self._block_tables[row, : len(pages)] = pages
         self._seq_lens[row] = 0
+        # device-side decode guard: a burst may never scatter past this row's
+        # allocated pages (nor past the cache-length cap)
+        self._row_limits[row] = min(len(pages) * self.page_size, self.max_seq_len - 1)
         self._set_row_sampling(row, req.sampling)
         self._prefill_chunk(req, finished)
         return True
@@ -287,38 +295,53 @@ class Engine:
         self._commit_token(req, int(token), finished)
 
     def _decode_step(self, finished: list[GenerationResult]) -> None:
+        """One decode dispatch: a fused burst of up to ``self.decode_burst``
+        iterations (serving/decode_burst.py) — tokens feed the next step on
+        device; the host syncs once per burst, then applies stop/length
+        bookkeeping and discards post-stop tokens."""
+        from githubrepostorag_tpu.serving.decode_burst import decode_burst
+
         rows = sorted(self._row_req)
         b = self.max_num_seqs
 
-        ids = np.zeros((b, 1), dtype=np.int32)
-        pos = np.zeros((b, 1), dtype=np.int32)
-        slots = np.full((b, 1), -1, dtype=np.int32)
-        new_lens = np.zeros((b,), dtype=np.int32)
+        last = np.zeros((b,), dtype=np.int32)
+        active = np.zeros((b,), dtype=bool)
+        remaining = 1
         for row in rows:
             req = self._row_req[row]
-            last = req.output[-1] if req.output else req.prompt[-1]
-            ids[row, 0] = last
-            pos[row, 0] = req.seq_len
-            slots[row, 0] = slot_mapping(
-                self._block_tables[row], req.seq_len, 1, self.page_size, 1
-            )[0]
-            new_lens[row] = 1
+            last[row] = req.output[-1] if req.output else req.prompt[-1]
+            active[row] = True
+            remaining = max(remaining, req.sampling.max_tokens - len(req.output))
+        n_steps = min(self.decode_burst, remaining)
 
-        logits, self._k_pages, self._v_pages = forward_paged(
+        if self._sampling_dirty:
+            self._temp_d = jnp.asarray(self._temp)
+            self._top_p_d = jnp.asarray(self._top_p)
+            self._top_k_d = jnp.asarray(self._top_k)
+            self._rep_pen_d = jnp.asarray(self._rep_pen)
+            self._sampling_dirty = False
+        self._rng, key = jax.random.split(self._rng)
+
+        toks, valid, self._k_pages, self._v_pages, self._presence, _ = decode_burst(
             self.params, self.cfg,
-            jnp.asarray(ids), jnp.asarray(pos),
-            self._k_pages, self._v_pages,
-            jnp.asarray(slots), jnp.asarray(self._block_tables),
-            jnp.asarray(self._seq_lens), jnp.asarray(new_lens),
-            use_pallas=self.use_pallas,
+            jnp.asarray(last), jnp.asarray(self._seq_lens),
+            self._k_pages, self._v_pages, self._presence,
+            jnp.asarray(active), jnp.asarray(self._row_limits),
+            jnp.asarray(self._block_tables), key,
+            self._temp_d, self._top_p_d, self._top_k_d, self._rep_pen_d,
+            n_steps=n_steps,
         )
+        toks = np.asarray(toks)  # [B, n_steps] — the one device->host sync
+        valid = np.asarray(valid)
 
-        tokens = self._sample_rows(logits[:, 0], np.asarray(rows, dtype=np.int32), full_batch=True)
-        for row in rows:
-            req = self._row_req[row]
-            req.seq_len += 1
-            self._seq_lens[row] = req.seq_len
-            self._commit_token(req, int(tokens[row]), finished)
+        for i in range(n_steps):
+            for row in rows:
+                req = self._row_req.get(row)
+                if req is None or req.state != "running" or not valid[row, i]:
+                    continue
+                req.seq_len += 1
+                self._seq_lens[row] = req.seq_len
+                self._commit_token(req, int(toks[row, i]), finished)
 
     def _sample_rows(self, logits: jnp.ndarray, rows: np.ndarray, full_batch: bool = False) -> np.ndarray:
         """Sample tokens for the given rows.  ``logits`` is [len(rows), V]
@@ -373,6 +396,7 @@ class Engine:
             self._free_rows.append(req.row)
             self._seq_lens[req.row] = 0
             self._block_tables[req.row] = 0
+            self._row_limits[req.row] = 0
             req.row = -1
         req.state = "done"
 

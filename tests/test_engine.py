@@ -4,6 +4,7 @@ batching, streaming callbacks, cancellation, page accounting."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from githubrepostorag_tpu.serving import Engine, SamplingParams
@@ -208,3 +209,46 @@ def test_top_k_sampling(tiny):
     )[0]
     # top_k=1 at any temperature collapses to greedy
     assert k1.output_tokens == greedy.output_tokens
+
+
+# ------------------------------------------------------- decode bursts ----
+
+
+def test_burst_matches_single_step_greedy():
+    """A fused 8-step burst must produce exactly the per-token greedy path."""
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    sp = SamplingParams(max_tokens=12, temperature=0.0, stop_token_ids=())
+
+    outs = []
+    for burst in (1, 8):
+        eng = Engine(params, cfg, max_num_seqs=2, num_pages=32, page_size=4,
+                     max_seq_len=64, decode_burst=burst)
+        outs.append([r.output_tokens for r in eng.generate(prompts, sp)])
+    assert outs[0] == outs[1]
+
+
+def test_burst_respects_stop_and_max_tokens():
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    # find the greedy continuation first, then set its 3rd token as stop
+    eng = Engine(params, cfg, max_num_seqs=1, num_pages=32, page_size=4,
+                 max_seq_len=64, decode_burst=8)
+    free = eng.generate([[1, 2, 3]], SamplingParams(max_tokens=6, temperature=0.0, stop_token_ids=()))[0]
+    stop_tok = free.output_tokens[0]  # tiny random models repeat greedily; first token is safe
+
+    eng2 = Engine(params, cfg, max_num_seqs=1, num_pages=32, page_size=4,
+                  max_seq_len=64, decode_burst=8)
+    res = eng2.generate([[1, 2, 3]], SamplingParams(max_tokens=6, temperature=0.0,
+                                                    stop_token_ids=(stop_tok,)))[0]
+    assert res.finish_reason == "stop"
+    assert res.output_tokens == free.output_tokens[:1]  # stop included, burst tail discarded
+
+    res3 = eng2.generate([[1, 2, 3]], SamplingParams(max_tokens=4, temperature=0.0,
+                                                     stop_token_ids=()))[0]
+    assert res3.finish_reason == "length" and len(res3.output_tokens) == 4
