@@ -1,0 +1,31 @@
+# Developer entrypoints (reference: Makefile — env create + per-component
+# pytest; here one package, one suite, plus native build / bench / deploy).
+
+.PHONY: all native test test-fast bench serve lint image deploy clean
+
+all: native test
+
+native:
+	$(MAKE) -C native
+
+test: native
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
+
+bench:
+	python bench.py
+
+serve:
+	python -m githubrepostorag_tpu.api --port 8080
+
+image:
+	docker build -t rag-tpu:latest -f docker/Dockerfile .
+
+deploy:
+	./start.sh
+
+clean:
+	$(MAKE) -C native clean || true
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -51,11 +51,8 @@ def _build_llm():
     return get_llm()
 
 
-async def serve(host: str, port: int, use_redis: bool) -> None:
-    from githubrepostorag_tpu.agent import GraphAgent
+async def serve(host: str, port: int, use_redis: bool, run_worker: bool = True) -> None:
     from githubrepostorag_tpu.api.app import RagApi
-    from githubrepostorag_tpu.metrics import MeteredLLM
-    from githubrepostorag_tpu.worker import RagWorker
 
     if use_redis:
         from githubrepostorag_tpu.events.redis import RedisBus, RedisCancelFlags, RedisJobQueue
@@ -66,17 +63,26 @@ async def serve(host: str, port: int, use_redis: bool) -> None:
 
         bus, flags, queue = MemoryBus(), MemoryCancelFlags(), MemoryJobQueue()
 
+    api = RagApi(bus, flags, queue)
+    await api.start(host=host, port=port)
+    logger.info("service up — UI at http://%s:%d/static/index.html", host, port)
+
+    if not run_worker:
+        # split deployment (rag-api pod): jobs are consumed by a separate
+        # ``python -m githubrepostorag_tpu.worker`` pod over Redis, like the
+        # reference's rag-api / rag-worker pair
+        while True:
+            await asyncio.sleep(3600)
+
+    from githubrepostorag_tpu.agent import GraphAgent
     from githubrepostorag_tpu.llm import set_llm
+    from githubrepostorag_tpu.metrics import MeteredLLM
+    from githubrepostorag_tpu.worker import RagWorker
 
     raw_llm = _build_llm()
     set_llm(raw_llm)  # health.py probes the shared instance for engine stats
-    llm = MeteredLLM(raw_llm)
-    agent = GraphAgent(llm)
+    agent = GraphAgent(MeteredLLM(raw_llm))
     worker = RagWorker(agent, bus, flags, queue)
-    api = RagApi(bus, flags, queue)
-
-    await api.start(host=host, port=port)
-    logger.info("service up — UI at http://%s:%d/static/index.html", host, port)
     await worker.run_forever()
 
 
@@ -86,8 +92,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--redis", action="store_true",
                         help="use Redis (REDIS_URL) for bus/queue instead of in-memory")
+    parser.add_argument("--no-worker", action="store_true",
+                        help="API only; a separate `python -m githubrepostorag_tpu.worker` "
+                             "pod consumes the queue (requires --redis)")
     args = parser.parse_args(argv)
-    asyncio.run(serve(args.host, args.port, args.redis))
+    if args.no_worker and not args.redis:
+        parser.error("--no-worker requires --redis (the queue must be shared)")
+    asyncio.run(serve(args.host, args.port, args.redis, run_worker=not args.no_worker))
     return 0
 
 

@@ -1,0 +1,66 @@
+"""Standalone model-server pod: ``python -m githubrepostorag_tpu.serving``.
+
+This is the in-tree replacement for the reference's vLLM Deployment
+(helm/templates/qwen-deployment.yaml:19-71 runs ``vllm/vllm-openai`` with
+``--model ... --max-model-len 11712 --max-num-seqs 4``): the same
+OpenAI-compatible surface (/v1/chat/completions, /v1/completions,
+/v1/models, /health) served by the JAX paged-KV engine on TPU.  Worker and
+ingest pods point QWEN_ENDPOINT here and set LLM_BACKEND=http, exactly as
+their reference counterparts pointed at the vLLM service.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+async def serve(host: str, port: int) -> None:
+    import jax
+    import ml_dtypes
+
+    from githubrepostorag_tpu.models.hf_loader import load_qwen2
+    from githubrepostorag_tpu.serving.async_engine import AsyncEngine
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.openai_api import OpenAIServer
+    from githubrepostorag_tpu.serving.tokenizer import HFTokenizer
+
+    s = get_settings()
+    if not s.model_weights_path:
+        raise SystemExit("model server requires MODEL_WEIGHTS_PATH (a local HF checkpoint dir)")
+    logger.info("loading weights from %s", s.model_weights_path)
+    params, cfg = load_qwen2(s.model_weights_path, dtype=ml_dtypes.bfloat16)
+    engine = Engine(
+        params, cfg,
+        max_num_seqs=s.max_num_seqs,
+        num_pages=s.kv_num_pages,
+        page_size=s.kv_page_size,
+        max_seq_len=s.context_window,
+        prefill_chunk=s.prefill_chunk,
+        use_pallas=jax.default_backend() == "tpu",
+    )
+    server = OpenAIServer(
+        AsyncEngine(engine), HFTokenizer(s.model_weights_path), model_name=s.qwen_model
+    )
+    bound = await server.start(host=host, port=port)
+    logger.info("model server up on %s:%d (backend=%s)", host, bound, jax.default_backend())
+    while True:  # serve until the pod is killed
+        await asyncio.sleep(3600)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="OpenAI-compatible TPU model server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    args = parser.parse_args(argv)
+    asyncio.run(serve(args.host, args.port))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Zero-to-running bootstrap — the bash equivalent of the reference's
+# start.ps1 (minikube + addons, image build in the cluster docker-env,
+# namespace reset, GitHub PAT secret, helm dependency update + install,
+# readiness polling).  TPU twist: on GKE pass --gke and skip minikube; the
+# model server schedules onto the TPU node pool via its nodeSelector.
+set -euo pipefail
+
+GITHUB_USER="${1:-}"
+NAMESPACE="rag"
+RELEASE="rag-demo"
+GKE=false
+for arg in "$@"; do
+  case "$arg" in
+    --gke) GKE=true ;;
+  esac
+done
+
+if [[ -z "$GITHUB_USER" ]]; then
+  read -rp "GitHub user to ingest: " GITHUB_USER
+fi
+
+if ! $GKE; then
+  echo "==> starting minikube"
+  minikube status >/dev/null 2>&1 || minikube start --cpus=8 --memory=16g
+  minikube addons enable default-storageclass >/dev/null
+  minikube addons enable storage-provisioner >/dev/null
+  echo "==> building image inside minikube docker-env"
+  eval "$(minikube docker-env)"
+fi
+
+docker build -t rag-tpu:latest -f docker/Dockerfile .
+
+echo "==> resetting namespace $NAMESPACE"
+if kubectl get namespace "$NAMESPACE" >/dev/null 2>&1; then
+  kubectl delete namespace "$NAMESPACE" --wait=false || true
+  # strip finalizers if the namespace wedges in Terminating (start.ps1:101-164)
+  for _ in $(seq 1 30); do
+    phase=$(kubectl get namespace "$NAMESPACE" -o jsonpath='{.status.phase}' 2>/dev/null || echo gone)
+    [[ "$phase" == "gone" ]] && break
+    if [[ "$phase" == "Terminating" ]]; then
+      kubectl get namespace "$NAMESPACE" -o json 2>/dev/null \
+        | python3 -c 'import json,sys; ns=json.load(sys.stdin); ns["spec"]["finalizers"]=[]; print(json.dumps(ns))' \
+        | kubectl replace --raw "/api/v1/namespaces/$NAMESPACE/finalize" -f - >/dev/null 2>&1 || true
+    fi
+    sleep 2
+  done
+fi
+kubectl create namespace "$NAMESPACE"
+
+echo "==> GitHub token secret (empty for anonymous, rate-limited)"
+read -rsp "GitHub PAT (enter to skip): " GITHUB_TOKEN; echo
+kubectl -n "$NAMESPACE" create secret generic github-token \
+  --from-literal=GITHUB_TOKEN="${GITHUB_TOKEN:-}" \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+echo "==> helm install"
+helm dependency update ./helm
+helm upgrade --install "$RELEASE" ./helm -n "$NAMESPACE" \
+  --set github.user="$GITHUB_USER"
+
+echo "==> waiting for cassandra"
+kubectl -n "$NAMESPACE" rollout status statefulset/"$RELEASE"-cassandra --timeout=600s || true
+echo "==> waiting for model server (weight load + XLA compile take minutes)"
+kubectl -n "$NAMESPACE" rollout status deployment/model-server --timeout=900s || true
+echo "==> waiting for api + worker"
+kubectl -n "$NAMESPACE" rollout status deployment/rag-api --timeout=600s
+kubectl -n "$NAMESPACE" rollout status deployment/rag-worker --timeout=600s
+
+if $GKE; then
+  echo "UI: kubectl -n $NAMESPACE port-forward svc/rag-api 8080:8080 -> http://localhost:8080/static/index.html"
+else
+  echo "UI: http://$(minikube ip):30800/static/index.html"
+fi
