@@ -42,7 +42,10 @@ def main() -> None:
     if on_tpu:
         cfg = Qwen2Config.qwen2_0_5b()
         batch, prompt_len, gen_tokens = 8, 128, 128
-        num_pages, page_size, max_seq = 1024, 16, 1024
+        # 256-token pages: the Pallas decode kernel walks pages as VMEM
+        # blocks, so bigger pages mean fewer (fixed-cost) grid steps; the
+        # coarser allocation granularity is irrelevant at serving batch sizes
+        num_pages, page_size, max_seq = 64, 256, 1024
         model_tag = "qwen2-0.5b"
     else:  # CPU fallback so the script still demonstrates end to end
         cfg = Qwen2Config.tiny()

@@ -157,6 +157,173 @@ def paged_attention_decode(
     return out.reshape(b, 1, n_q, hd)
 
 
+def _decode_staged_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_pages] SMEM
+    pool_lens_ref,  # [B] SMEM — frozen pool-prefix length per row
+    staged_len_ref,  # [1] SMEM — valid staged entries (uniform across rows)
+    # blocks
+    q_ref,  # [1, n_kv, group, hd] VMEM — all kv heads of one row
+    k_ref,  # [n_kv, 1, page_size, hd] VMEM (one pool page, every kv head)
+    v_ref,  # [n_kv, 1, page_size, hd] VMEM
+    sk_ref,  # [1, n_kv, n_steps, hd] VMEM — this row's staged K tail
+    sv_ref,  # [1, n_kv, n_steps, hd] VMEM
+    out_ref,  # [1, n_kv, group, hd] VMEM
+    # scratch
+    m_ref,  # [n_kv, group, 128] f32
+    l_ref,  # [n_kv, group, 128] f32
+    acc_ref,  # [n_kv, group, hd] f32
+    *,
+    page_size: int,
+    scale: float,
+):
+    """Decode-burst attention: online softmax over [pool-prefix pages |
+    staged tail].  Grid (B, max_pages + 1): the first max_pages steps walk
+    the row's block table for ALL kv heads at once (skipping pages past
+    ``pool_lens``); the final step folds in the burst's staged K/V
+    (positions < ``staged_len``) and writes the normalized output.  One
+    grid step per (row, page) — not per (row, head, page) — keeps the
+    kernel's fixed per-step cost off the decode critical path."""
+    bi = pl.program_id(0)
+    pi = pl.program_id(1)
+    num_pi = pl.num_programs(1)
+
+    @pl.when(pi == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    total = pool_lens_ref[bi]
+    page_start = pi * page_size
+
+    # batched-over-heads dot: [n_kv, g, hd] x [n_kv, T, hd] -> [n_kv, g, T]
+    bdot = lambda a, b: jax.lax.dot_general(
+        a, b, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )
+    # [n_kv, g, T] x [n_kv, T, hd] -> [n_kv, g, hd]
+    pdot = lambda p, v: jax.lax.dot_general(
+        p, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    )
+
+    def accumulate(s, vals):
+        """Online-softmax update: s [n_kv, g, T] over vals [n_kv, T, hd]."""
+        m_prev = m_ref[:, :, :1]
+        l_prev = l_ref[:, :, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[:, :, :1] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + pdot(p, vals)
+        m_ref[:, :, :1] = m_new
+
+    @pl.when((pi < num_pi - 1) & (page_start < total))
+    def _():
+        q = q_ref[0].astype(jnp.float32)  # [n_kv, group, hd]
+        k = k_ref[:, 0].astype(jnp.float32)  # [n_kv, page_size, hd]
+        s = bdot(q, k) * scale  # [n_kv, group, page_size]
+        kv_pos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(kv_pos < total, s, NEG_INF)
+        accumulate(s, v_ref[:, 0].astype(jnp.float32))
+
+    @pl.when(pi == num_pi - 1)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        sk = sk_ref[0].astype(jnp.float32)  # [n_kv, n_steps, hd]
+        s = bdot(q, sk) * scale  # [n_kv, group, n_steps]
+        idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(idx < staged_len_ref[0], s, NEG_INF)
+        accumulate(s, sv_ref[0].astype(jnp.float32))
+
+        # staged_len >= 1 always, so l > 0 for every row incl. padding rows
+        l = l_ref[:, :, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0] = (acc_ref[...] / safe_l).astype(out_ref.dtype)
+
+
+def paged_attention_decode_staged(
+    q: jnp.ndarray,  # [B, 1, n_q, hd]
+    k_pages: jnp.ndarray,  # [n_kv, P, page_size, hd] — frozen pool
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, max_pages]
+    pool_lens: jnp.ndarray,  # [B] — valid pool-prefix tokens per row
+    staged_k: jnp.ndarray,  # [B, n_kv, n_steps, hd] — burst staging buffer
+    staged_v: jnp.ndarray,
+    staged_len: jnp.ndarray,  # [1] int32 — staged entries valid this step
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Burst-decode attention over [pool prefix | staged tail] without ever
+    materializing the gathered KV in HBM (replaces gather_kv+dense in
+    serving/decode_burst.py).  Not jitted — always called inside the burst's
+    compiled program."""
+    b, s, n_q, hd = q.shape
+    assert s == 1, "staged kernel is the decode path (S == 1)"
+    n_kv, num_pages, page_size, _ = k_pages.shape
+    group = n_q // n_kv
+    max_pages = block_tables.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+    q_r = q.reshape(b, n_kv, group, hd)
+
+    grid = (b, max_pages + 1)
+
+    def q_map(bi, pi, bt, pool, sl):
+        return (bi, 0, 0, 0)
+
+    def kv_map(bi, pi, bt, pool, sl):
+        # Clamp the walk to allocated pages; the staged grid step and pages
+        # past the row's prefix skip compute, so any valid page id works.
+        pp = jnp.minimum(pi, max_pages - 1)
+        page = jax.lax.select(
+            (pi < max_pages) & (pi * page_size < pool[bi]), bt[bi, pp], 0
+        )
+        return (0, page, 0, 0)
+
+    def staged_map(bi, pi, bt, pool, sl):
+        return (bi, 0, 0, 0)
+
+    n_steps = staged_k.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n_kv, group, hd), q_map),
+            pl.BlockSpec((n_kv, 1, page_size, hd), kv_map),
+            pl.BlockSpec((n_kv, 1, page_size, hd), kv_map),
+            pl.BlockSpec((1, n_kv, n_steps, hd), staged_map),
+            pl.BlockSpec((1, n_kv, n_steps, hd), staged_map),
+        ],
+        out_specs=pl.BlockSpec((1, n_kv, group, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, group, 128), jnp.float32),
+            pltpu.VMEM((n_kv, group, 128), jnp.float32),
+            pltpu.VMEM((n_kv, group, hd), jnp.float32),
+        ],
+    )
+
+    kernel = functools.partial(_decode_staged_kernel, page_size=page_size, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, group, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        pool_lens.astype(jnp.int32),
+        staged_len.astype(jnp.int32),
+        q_r,
+        k_pages,
+        v_pages,
+        staged_k,
+        staged_v,
+    )
+
+    return out.reshape(b, 1, n_q, hd)
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, cached_lens, new_lens):
     """Dispatcher with the paged_attention_ref contract: Pallas for decode
     steps, gather+dense for prefill chunks (S > 1)."""

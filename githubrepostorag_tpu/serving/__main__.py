@@ -44,6 +44,8 @@ async def serve(host: str, port: int) -> None:
         prefill_chunk=s.prefill_chunk,
         use_pallas=jax.default_backend() == "tpu",
     )
+    logger.info("precompiling engine programs (prefill buckets + decode burst)")
+    engine.warmup()
     server = OpenAIServer(
         AsyncEngine(engine), HFTokenizer(s.model_weights_path), model_name=s.qwen_model
     )

@@ -12,10 +12,16 @@ Why the staged buffer: scattering each step's K/V straight into the page
 pools would drag the full pools through the scan carry — XLA then moves the
 whole pool (hundreds of MB) every iteration, which measured ~3 ms/step of
 pure copy at P=1024.  Instead the pools stay **loop-invariant** inside the
-burst: new K/V go to a tiny [L, B, N] staging buffer (~MBs), attention per
-step covers (frozen pool prefix) + (staged tail so far) via an explicit
-validity mask, and the staged tokens are scattered into the pools ONCE at
-burst end.
+burst: new K/V go to a tiny [L, B, n_kv, N, hd] staging buffer (~MBs),
+attention per step covers (frozen pool prefix) + (staged tail so far), and
+the staged tokens are scattered into the pools ONCE at burst end.
+
+Attention inside the burst has two implementations (``use_pallas``):
+  - the Pallas flash-decode kernel extended with a staged-tail operand
+    (ops/pallas_paged.py::paged_attention_decode_staged) — walks the block
+    table page by page in VMEM, nothing materialized in HBM.  The TPU path.
+  - gather_kv + dense attention over the materialized copy — the CPU test
+    path and the kernel's correctness oracle.
 
 Inside the burst everything stays on device: sampled tokens feed the next
 step's embedding lookup directly and the repetition-penalty presence mask
@@ -38,13 +44,14 @@ import jax.numpy as jnp
 from githubrepostorag_tpu.models.qwen2 import Qwen2Config, _block, _logits
 from githubrepostorag_tpu.ops.attention import dense_attention
 from githubrepostorag_tpu.ops.paged_attention import gather_kv
+from githubrepostorag_tpu.ops.pallas_paged import paged_attention_decode_staged
 from githubrepostorag_tpu.ops.rope import rope_cos_sin
 from githubrepostorag_tpu.ops.sampling import sample_tokens_capped
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps"),
+    static_argnames=("cfg", "n_steps", "use_pallas"),
     donate_argnums=(4, 5, 6),
 )
 def decode_burst(
@@ -64,13 +71,17 @@ def decode_burst(
     top_k: jnp.ndarray,  # [B] int32
     repetition_penalty: jnp.ndarray,  # [B]
     n_steps: int,
+    use_pallas: bool = False,
 ):
     """Run ``n_steps`` decode iterations for every active row.
 
     Returns (tokens [B, n_steps] int32, valid [B, n_steps] bool, k_pages,
-    v_pages, presence, seq_lens).  ``valid[b, i]`` marks tokens produced
-    while row b was still active (inactive rows repeat their last token,
-    masked out here so the host never commits them).
+    v_pages, presence, seq_lens).  ``tokens`` is PACKED: positions where the
+    row was inactive hold -1, so the host learns tokens and validity from a
+    single [B, n_steps] transfer (one device->host round trip per burst —
+    the transfer latency, not bandwidth, is what a remote-TPU tunnel
+    charges for).  ``valid`` (= tokens >= 0) stays a device output for
+    in-program consumers and tests.
     """
     b = last_tokens.shape[0]
     L = cfg.num_layers
@@ -80,7 +91,7 @@ def decode_burst(
     start_lens = seq_lens  # pool validity is frozen for the whole burst
     kv_dtype = k_pages.dtype
 
-    staged_shape = (L, b, n_steps, n_kv, hd)
+    staged_shape = (L, b, n_kv, n_steps, hd)
     staged_k0 = jnp.zeros(staged_shape, dtype=kv_dtype)
     staged_v0 = jnp.zeros(staged_shape, dtype=kv_dtype)
     staged_idx = jnp.arange(n_steps)
@@ -90,29 +101,47 @@ def decode_burst(
         step, step_rng = step_xs
         act = act & (lens < row_limits)
 
-        h = jnp.take(params["embed"], last[:, None], axis=0)  # [B, 1, d]
+        # last may carry the -1 inactive sentinel (packed tokens chained
+        # across bursts); clamp so inactive rows look up a real embedding
+        h = jnp.take(params["embed"], jnp.maximum(last, 0)[:, None], axis=0)  # [B, 1, d]
         cos, sin = rope_cos_sin(lens[:, None], hd, cfg.rope_theta)
 
-        # kv validity over [pool prefix | staged tail]: pool positions are
-        # valid below each row's burst-start length; staged positions are
-        # valid up to and including this step (the new token attends itself)
-        staged_valid = (staged_idx <= step)[None, :]  # [1, n_steps]
+        def attend_for(kp, vp, sk, sv):
+            def stage(sk, sv, k_new, v_new):
+                """Write this step's K/V at staged position ``step``.
+                sk/sv: [B, n_kv, n_steps, hd]; k_new/v_new: [B, 1, n_kv, hd]."""
+                k_t = k_new.swapaxes(1, 2).astype(kv_dtype)  # [B, n_kv, 1, hd]
+                v_t = v_new.swapaxes(1, 2).astype(kv_dtype)
+                write = lambda s, new: jax.lax.dynamic_update_slice(
+                    s, new, (0, step, 0)
+                )
+                return jax.vmap(write)(sk, k_t), jax.vmap(write)(sv, v_t)
 
-        def attend_for(kp, vp, sk, sv, layer_step):
+            if use_pallas:
+
+                def attend(q, k_new, v_new):
+                    sk2, sv2 = stage(sk, sv, k_new, v_new)
+                    out = paged_attention_decode_staged(
+                        q, kp, vp, block_tables, start_lens, sk2, sv2,
+                        staged_len=jnp.reshape(step + 1, (1,)),
+                        interpret=jax.default_backend() != "tpu",
+                    )
+                    return out, (sk2, sv2)
+
+                return attend
+
             pool_k, pool_v = gather_kv(kp, vp, block_tables)  # [B, mp*ps, n_kv, hd]
             pool_valid = (
                 jnp.arange(pool_k.shape[1])[None, :] < start_lens[:, None]
             )
+            # staged positions are valid up to and including this step (the
+            # new token attends itself)
+            staged_valid = (staged_idx <= step)[None, :]  # [1, n_steps]
 
             def attend(q, k_new, v_new):
-                sk2 = jax.vmap(
-                    lambda s, new: jax.lax.dynamic_update_slice(s, new, (layer_step, 0, 0))
-                )(sk, k_new.astype(kv_dtype))
-                sv2 = jax.vmap(
-                    lambda s, new: jax.lax.dynamic_update_slice(s, new, (layer_step, 0, 0))
-                )(sv, v_new.astype(kv_dtype))
-                k_all = jnp.concatenate([pool_k, sk2], axis=1)
-                v_all = jnp.concatenate([pool_v, sv2], axis=1)
+                sk2, sv2 = stage(sk, sv, k_new, v_new)
+                k_all = jnp.concatenate([pool_k, sk2.swapaxes(1, 2)], axis=1)
+                v_all = jnp.concatenate([pool_v, sv2.swapaxes(1, 2)], axis=1)
                 valid = jnp.concatenate(
                     [pool_valid, jnp.broadcast_to(staged_valid, (b, n_steps))], axis=1
                 )
@@ -123,9 +152,7 @@ def decode_burst(
 
         def layer_body(h, layer_xs):
             p, kp, vp, sk, sv = layer_xs
-            h, (sk, sv) = _block(
-                cfg, h, p, cos, sin, attend_for(kp, vp, sk, sv, step)
-            )
+            h, (sk, sv) = _block(cfg, h, p, cos, sin, attend_for(kp, vp, sk, sv))
             return h, (sk, sv)
 
         h, (staged_k, staged_v) = jax.lax.scan(
@@ -148,6 +175,7 @@ def decode_burst(
         one_step, carry0, (jnp.arange(n_steps), keys)
     )
     toks, valid = toks.T, valid.T  # [B, n_steps]
+    packed = jnp.where(valid, toks, -1)
 
     # one scatter commits the whole burst's staged K/V into the pools
     total_slots = num_pages * page_size
@@ -159,10 +187,11 @@ def decode_burst(
 
     def commit(pools, staged):
         flat = pools.reshape(L, n_kv, total_slots, hd)
-        vals = staged.reshape(L, b * n_steps, n_kv, hd).swapaxes(1, 2)  # [L, n_kv, B*n, hd]
+        # [L, B, n_kv, n, hd] -> [L, n_kv, B*n, hd] matching flat_slots order
+        vals = staged.swapaxes(1, 2).reshape(L, n_kv, b * n_steps, hd)
         flat = flat.at[:, :, flat_slots].set(vals, mode="drop")
         return flat.reshape(pools.shape)
 
     k_pages = commit(k_pages, staged_k)
     v_pages = commit(v_pages, staged_v)
-    return toks, valid, k_pages, v_pages, presence, out_lens
+    return packed, valid, k_pages, v_pages, presence, out_lens

@@ -27,6 +27,7 @@ from __future__ import annotations
 import itertools
 import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Callable
 
 import jax
@@ -138,6 +139,17 @@ class Engine:
         self._requests: dict[str, _Request] = {}
         self._ids = itertools.count()
 
+        # Pipelined decode: while only decoding, burst k+1 is dispatched
+        # BEFORE burst k's tokens are fetched, so the device->host sync
+        # (~100 ms through a remote-TPU tunnel) overlaps the next burst's
+        # compute.  ``_chain`` holds the device-side continuation state
+        # (last tokens + seq lens from the in-flight burst) and the pending
+        # unfetched result; ``_deferred`` holds finished rows whose pages
+        # can't be recycled until the in-flight burst that still references
+        # them has landed.
+        self._chain: dict | None = None
+        self._deferred: list[tuple[int, list[int]]] = []
+
     # ------------------------------------------------------------- intake --
 
     def add_request(
@@ -207,6 +219,10 @@ class Engine:
         did_prefill = self._try_prefill(finished)
         if not did_prefill and self._row_req:
             self._decode_step(finished)
+        if not self._row_req:
+            # nothing left running: land any in-flight burst (its tokens
+            # belong to already-finished rows) and recycle deferred pages
+            self._drain_chain(finished)
         return finished
 
     def _reap_cancelled(self, finished: list[GenerationResult]) -> None:
@@ -219,54 +235,95 @@ class Engine:
                 self._release(req)
                 finished.append(self._result(req, "cancelled"))
 
-    def _try_prefill(self, finished: list[GenerationResult]) -> bool:
-        """Admit the next waiting request (or continue a partial prefill).
-        Returns True if a prefill chunk ran."""
-        # continue an in-flight chunked prefill first
-        for req in self._row_req.values():
-            if req.state == "prefilling":
-                self._prefill_chunk(req, finished)
-                return True
-        if not self._waiting or not self._free_rows:
+    def _admission_feasible(self) -> bool:
+        """True when the head-of-queue request could actually be admitted
+        (row + pages available, counting rows/pages that a chain drain would
+        recycle).  Draining the decode pipeline is expensive — don't do it
+        for an admission the allocator would refuse anyway."""
+        if not self._waiting:
             return False
         req = self._waiting[0]
-        need = pages_needed(min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size)
-        assert need <= self.max_pages_per_seq, "intake clamp must bound the page need"
-        try:
-            pages = self._allocator.allocate(need)
-        except OutOfPages:
-            return False  # wait for running requests to finish
-        self._waiting.pop(0)
-        row = self._free_rows.pop()
-        req.row, req.pages, req.state = row, pages, "prefilling"
-        self._row_req[row] = req
-        self._block_tables[row, : len(pages)] = pages
-        self._seq_lens[row] = 0
-        # device-side decode guard: a burst may never scatter past this row's
-        # allocated pages (nor past the cache-length cap)
-        self._row_limits[row] = min(len(pages) * self.page_size, self.max_seq_len - 1)
-        self._set_row_sampling(row, req.sampling)
-        self._prefill_chunk(req, finished)
+        need = pages_needed(
+            min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size
+        )
+        rows_avail = bool(self._free_rows) or bool(self._deferred)
+        pages_after_drain = self._allocator.free_count + sum(
+            len(pages) for _, pages in self._deferred
+        )
+        return rows_avail and pages_after_drain >= need
+
+    def _try_prefill(self, finished: list[GenerationResult]) -> bool:
+        """Admit every waiting request the pool can back, then run ONE
+        batched prefill chunk over all prefilling rows.  Returns True if a
+        prefill chunk ran."""
+        wants_prefill = any(
+            r.state == "prefilling" for r in self._row_req.values()
+        ) or self._admission_feasible()
+        if wants_prefill:
+            # prefill mutates block tables / seq lens / presence rows that an
+            # in-flight decode burst snapshot still uses — land it first
+            self._drain_chain(finished)
+        # admit as many waiting requests as rows + pages allow
+        while self._waiting and self._free_rows:
+            req = self._waiting[0]
+            need = pages_needed(
+                min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size
+            )
+            assert need <= self.max_pages_per_seq, "intake clamp must bound the page need"
+            try:
+                pages = self._allocator.allocate(need)
+            except OutOfPages:
+                break  # wait for running requests to finish
+            self._waiting.pop(0)
+            row = self._free_rows.pop()
+            req.row, req.pages, req.state = row, pages, "prefilling"
+            self._row_req[row] = req
+            self._block_tables[row, : len(pages)] = pages
+            self._seq_lens[row] = 0
+            # device-side decode guard: a burst may never scatter past this
+            # row's allocated pages (nor past the cache-length cap)
+            self._row_limits[row] = min(len(pages) * self.page_size, self.max_seq_len - 1)
+            self._set_row_sampling(row, req.sampling)
+        prefilling = [r for r in self._row_req.values() if r.state == "prefilling"]
+        if not prefilling:
+            return False
+        self._prefill_batch(prefilling, finished)
         return True
 
     # ------------------------------------------------------------ compute --
 
-    def _prefill_chunk(self, req: _Request, finished: list[GenerationResult]) -> None:
-        start = req.prefill_pos
-        remaining = len(req.prompt) - start
-        valid = min(remaining, self.prefill_chunk)
-        bucket = _bucket(valid, self.prefill_chunk)
+    def _prefill_batch(self, reqs: list[_Request], finished: list[GenerationResult]) -> None:
+        """One prefill dispatch covering a chunk of EVERY prefilling row —
+        vLLM-style batched prefill compute rather than one program per
+        request.  Rows at different prompt offsets ride the same program via
+        per-row positions / cached_lens / slot mappings; rows whose prompt
+        completes this chunk get their first token sampled in one batched
+        call (a single device->host sync for the whole admission wave)."""
+        n = len(reqs)
+        # Shape discipline: row count buckets to powers of two, width is
+        # ALWAYS prefill_chunk.  Every distinct device shape is a multi-second
+        # XLA compile; steady-state traffic must only ever see shapes that
+        # warmup() has already compiled.
+        rb = _bucket(n, self.max_num_seqs, minimum=1)
+        width = self.prefill_chunk
 
-        ids = np.zeros((1, bucket), dtype=np.int32)
-        ids[0, :valid] = req.prompt[start : start + valid]
-        pos = np.zeros((1, bucket), dtype=np.int32)
-        pos[0] = np.arange(start, start + bucket)
-        slots = slot_mapping(self._block_tables[req.row], start, valid, self.page_size, bucket)[None, :]
-
-        # single-row views shaped for the batch-1 prefill program
-        bt = self._block_tables[req.row : req.row + 1]
-        cached = np.asarray([start], dtype=np.int32)
-        new_lens = np.asarray([valid], dtype=np.int32)
+        ids = np.zeros((rb, width), dtype=np.int32)
+        pos = np.zeros((rb, width), dtype=np.int32)
+        slots = np.full((rb, width), -1, dtype=np.int32)
+        bt = np.zeros((rb, self.max_pages_per_seq), dtype=np.int32)
+        cached = np.zeros((rb,), dtype=np.int32)
+        new_lens = np.zeros((rb,), dtype=np.int32)
+        valids = []
+        for i, req in enumerate(reqs):
+            start = req.prefill_pos
+            valid = min(len(req.prompt) - start, self.prefill_chunk)
+            valids.append(valid)
+            ids[i, :valid] = req.prompt[start : start + valid]
+            pos[i] = np.arange(start, start + width)
+            slots[i] = slot_mapping(self._block_tables[req.row], start, valid, self.page_size, width)
+            bt[i] = self._block_tables[req.row]
+            cached[i] = start
+            new_lens[i] = valid
 
         logits, self._k_pages, self._v_pages = forward_paged(
             self.params, self.cfg,
@@ -277,98 +334,150 @@ class Engine:
             use_pallas=self.use_pallas,
         )
 
-        req.prefill_pos += valid
-        req.seq_len = req.prefill_pos
-        self._seq_lens[req.row] = req.seq_len
+        # mark prompt tokens in the presence mask (repetition penalty input);
+        # one batched scatter for the whole padded wave (padding rows have
+        # lens 0, so their scatter drops everything)
+        row_idx = np.zeros((rb,), dtype=np.int32)
+        row_idx[:n] = [r.row for r in reqs]
+        row_d = jnp.asarray(row_idx)
+        self._presence = _mark_presence_chunks(
+            self._presence, row_d, jnp.asarray(ids), jnp.asarray(new_lens),
+            self.cfg.vocab_size,
+        )
 
-        # mark prompt tokens in the presence mask (repetition penalty input)
-        chunk_ids = jnp.asarray(ids[0, :valid])
-        self._presence = _mark_presence(self._presence, req.row, chunk_ids)
+        done_idx: list[int] = []
+        for i, req in enumerate(reqs):
+            req.prefill_pos += valids[i]
+            req.seq_len = req.prefill_pos
+            self._seq_lens[req.row] = req.seq_len
+            if req.prefill_pos >= len(req.prompt):
+                done_idx.append(i)
 
-        if req.prefill_pos < len(req.prompt):
-            return  # more chunks to go
+        if not done_idx:
+            return  # every row has more chunks to go
 
-        # prompt fully cached: sample the first token from the last position
-        req.state = "running"
-        last_logits = logits[:, valid - 1]  # [1, V]
-        token = self._sample_rows(last_logits, np.asarray([req.row]))[0]
-        self._commit_token(req, int(token), finished)
+        # Prompts fully cached for some rows: sample first tokens.  The
+        # sampling program always sees the full [rb] padded batch (one
+        # compiled shape per row bucket); rows that aren't done sample too
+        # but their tokens are discarded and their presence scatter masked.
+        last_idx = np.zeros((rb,), dtype=np.int32)
+        for i, v in enumerate(valids):
+            last_idx[i] = v - 1
+        done_mask = np.zeros((rb,), dtype=bool)
+        done_mask[done_idx] = True
+
+        self._push_sampling()
+        self._rng, key = jax.random.split(self._rng)
+        last_logits = jnp.take_along_axis(
+            logits, jnp.asarray(last_idx)[:, None, None], axis=1
+        )[:, 0]  # [rb, V]
+        tokens_d = sample_tokens(
+            last_logits, key,
+            self._temp_d[row_d], self._top_p_d[row_d], self._top_k_d[row_d],
+            self._rep_pen_d[row_d], self._presence[row_d],
+        )
+        safe = jnp.where(jnp.asarray(done_mask), tokens_d, self.cfg.vocab_size)
+        self._presence = _mark_presence_rows(self._presence, row_d, safe)
+        tokens = np.asarray(tokens_d)  # one sync for the whole wave
+        for i in done_idx:
+            req = reqs[i]
+            req.state = "running"
+            self._commit_token(req, int(tokens[i]), finished)
 
     def _decode_step(self, finished: list[GenerationResult]) -> None:
         """One decode dispatch: a fused burst of up to ``self.decode_burst``
         iterations (serving/decode_burst.py) — tokens feed the next step on
-        device; the host syncs once per burst, then applies stop/length
-        bookkeeping and discards post-stop tokens."""
+        device.  Bursts are PIPELINED: this dispatch reuses the in-flight
+        burst's device-side last-token/seq-len state, and only then fetches
+        the previous burst's tokens — so the device->host sync overlaps the
+        new burst's compute.  Stop/length bookkeeping therefore lags the
+        device by one burst; tokens a row produced past its stop are
+        discarded at commit, and its pages are recycled once no in-flight
+        burst references them (``_drain_chain``)."""
         from githubrepostorag_tpu.serving.decode_burst import decode_burst
 
-        rows = sorted(self._row_req)
         b = self.max_num_seqs
-
-        last = np.zeros((b,), dtype=np.int32)
         active = np.zeros((b,), dtype=bool)
         remaining = 1
-        for row in rows:
-            req = self._row_req[row]
-            last[row] = req.output[-1] if req.output else req.prompt[-1]
+        for row, req in self._row_req.items():
             active[row] = True
             remaining = max(remaining, req.sampling.max_tokens - len(req.output))
-        n_steps = min(self.decode_burst, remaining)
+        # ONE compiled burst shape: always decode_burst steps.  Overshoot
+        # past a row's max_tokens is discarded at commit — with continuous
+        # batching the "wasted" steps still serve every other running row,
+        # and a single shape means a single multi-second XLA compile.
+        n_steps = self.decode_burst
 
-        if self._sampling_dirty:
-            self._temp_d = jnp.asarray(self._temp)
-            self._top_p_d = jnp.asarray(self._top_p)
-            self._top_k_d = jnp.asarray(self._top_k)
-            self._rep_pen_d = jnp.asarray(self._rep_pen)
-            self._sampling_dirty = False
+        if self._chain is not None and remaining <= self._chain["pending"].shape[1]:
+            # the in-flight burst already covers every row's token budget
+            # (host's `remaining` is stale by exactly that burst): land it
+            # instead of dispatching a speculative extra burst that would be
+            # discarded at drain
+            self._drain_chain(finished)
+            return
+
+        if self._chain is None:
+            last = np.zeros((b,), dtype=np.int32)
+            for row, req in self._row_req.items():
+                last[row] = req.output[-1] if req.output else req.prompt[-1]
+            last_d = jnp.asarray(last)
+            lens_d = jnp.asarray(self._seq_lens)
+        else:
+            last_d = self._chain["last"]
+            lens_d = self._chain["lens"]
+
+        self._push_sampling()
         self._rng, key = jax.random.split(self._rng)
 
-        toks, valid, self._k_pages, self._v_pages, self._presence, _ = decode_burst(
+        toks, valid, self._k_pages, self._v_pages, self._presence, out_lens = decode_burst(
             self.params, self.cfg,
-            jnp.asarray(last), jnp.asarray(self._seq_lens),
+            last_d, lens_d,
             self._k_pages, self._v_pages, self._presence,
             jnp.asarray(active), jnp.asarray(self._row_limits),
             jnp.asarray(self._block_tables), key,
             self._temp_d, self._top_p_d, self._top_k_d, self._rep_pen_d,
-            n_steps=n_steps,
+            n_steps=n_steps, use_pallas=self.use_pallas,
         )
-        toks = np.asarray(toks)  # [B, n_steps] — the one device->host sync
-        valid = np.asarray(valid)
+        prev = self._chain["pending"] if self._chain is not None else None
+        self._chain = {"last": toks[:, -1], "lens": out_lens, "pending": toks}
+        if prev is not None:
+            self._commit_burst(prev, finished)
 
-        for i in range(n_steps):
-            for row in rows:
+    def _commit_burst(self, pending: jnp.ndarray, finished: list[GenerationResult]) -> None:
+        """Fetch a burst's packed tokens — ONE [B, n_steps] transfer, the
+        single device->host round trip per burst — and apply stop/length
+        bookkeeping.  Position (row, i) holds -1 where the row was inactive;
+        rows already released ignore their tokens."""
+        toks = np.asarray(pending)  # [B, n_steps]
+        for i in range(toks.shape[1]):
+            for row in sorted(self._row_req):
                 req = self._row_req.get(row)
-                if req is None or req.state != "running" or not valid[row, i]:
+                if req is None or req.state != "running" or toks[row, i] < 0:
                     continue
                 req.seq_len += 1
                 self._seq_lens[row] = req.seq_len
                 self._commit_token(req, int(toks[row, i]), finished)
 
-    def _sample_rows(self, logits: jnp.ndarray, rows: np.ndarray, full_batch: bool = False) -> np.ndarray:
-        """Sample tokens for the given rows.  ``logits`` is [len(rows), V]
-        (or [max_num_seqs, V] when full_batch)."""
+    def _drain_chain(self, finished: list[GenerationResult]) -> None:
+        """Land the in-flight burst (if any), commit its tokens, and recycle
+        every deferred row/page now that nothing on device references them."""
+        if self._chain is not None:
+            pending = self._chain["pending"]
+            self._chain = None  # releases during this commit recycle directly
+            self._commit_burst(pending, finished)
+        for row, pages in self._deferred:
+            self._allocator.release(pages)
+            self._free_rows.append(row)
+        self._deferred.clear()
+
+    def _push_sampling(self) -> None:
+        """Mirror host sampling params to device arrays when dirty."""
         if self._sampling_dirty:
             self._temp_d = jnp.asarray(self._temp)
             self._top_p_d = jnp.asarray(self._top_p)
             self._top_k_d = jnp.asarray(self._top_k)
             self._rep_pen_d = jnp.asarray(self._rep_pen)
             self._sampling_dirty = False
-        self._rng, key = jax.random.split(self._rng)
-        if full_batch:
-            toks = sample_tokens(
-                logits, key, self._temp_d, self._top_p_d, self._top_k_d,
-                self._rep_pen_d, self._presence
-            )
-            self._presence = _mark_presence_rows(self._presence, jnp.asarray(rows), toks[jnp.asarray(rows)])
-            return np.asarray(toks)
-        row_idx = jnp.asarray(rows)
-        toks = sample_tokens(
-            logits, key,
-            self._temp_d[row_idx], self._top_p_d[row_idx], self._top_k_d[row_idx],
-            self._rep_pen_d[row_idx],
-            self._presence[row_idx],
-        )
-        self._presence = _mark_presence_rows(self._presence, row_idx, toks)
-        return np.asarray(toks)
 
     # ---------------------------------------------------------- lifecycle --
 
@@ -391,9 +500,14 @@ class Engine:
 
     def _release(self, req: _Request) -> None:
         if req.row >= 0:
-            self._allocator.release(req.pages)
+            if self._chain is not None:
+                # an in-flight burst still reads this row's pages; recycle
+                # only after the chain drains
+                self._deferred.append((req.row, req.pages))
+            else:
+                self._allocator.release(req.pages)
+                self._free_rows.append(req.row)
             self._row_req.pop(req.row, None)
-            self._free_rows.append(req.row)
             self._seq_lens[req.row] = 0
             self._block_tables[req.row] = 0
             self._row_limits[req.row] = 0
@@ -425,6 +539,26 @@ class Engine:
 
     # --------------------------------------------------------- convenience --
 
+    def warmup(self) -> None:
+        """Precompile every steady-state device program — prefill at each
+        row bucket, the decode burst, first-token sampling — so live traffic
+        never hits a multi-second XLA compile mid-request (vLLM warms up its
+        CUDA graphs the same way; on a remote-compile TPU tunnel a cold
+        shape costs tens of seconds).  Runs tiny throwaway requests through
+        the public step loop and leaves the engine state clean."""
+        buckets = []
+        b = 1
+        while True:
+            buckets.append(min(b, self.max_num_seqs))
+            if b >= self.max_num_seqs:
+                break
+            b *= 2
+        sp = SamplingParams(max_tokens=2, temperature=0.0, stop_token_ids=())
+        for nb in buckets:
+            prompts = [[1, 2, 3]] * nb
+            self.generate(prompts, sp)
+        logger.info("engine warmup complete (%d prefill row buckets)", len(buckets))
+
     def generate(
         self,
         prompts: list[list[int]],
@@ -446,9 +580,19 @@ class Engine:
 # ---- small jitted presence-mask helpers ----------------------------------
 
 
-@jax.jit
-def _mark_presence(presence: jnp.ndarray, row: int, token_ids: jnp.ndarray) -> jnp.ndarray:
-    return presence.at[row, token_ids].set(True, mode="drop")
+@partial(jax.jit, static_argnames=("vocab",))
+def _mark_presence_chunks(
+    presence: jnp.ndarray,  # [rows, V] bool
+    row_idx: jnp.ndarray,  # [R] int32
+    ids: jnp.ndarray,  # [R, W] int32 prompt-chunk tokens (right-padded)
+    lens: jnp.ndarray,  # [R] valid tokens per row
+    vocab: int,
+) -> jnp.ndarray:
+    """Batched prompt-token presence marking: padding positions map to an
+    out-of-range sentinel that the drop-mode scatter discards."""
+    valid = jnp.arange(ids.shape[1])[None, :] < lens[:, None]
+    safe_ids = jnp.where(valid, ids, vocab)
+    return presence.at[row_idx[:, None], safe_ids].set(True, mode="drop")
 
 
 @jax.jit

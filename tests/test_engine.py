@@ -252,3 +252,17 @@ def test_burst_respects_stop_and_max_tokens():
     res3 = eng2.generate([[1, 2, 3]], SamplingParams(max_tokens=4, temperature=0.0,
                                                      stop_token_ids=()))[0]
     assert res3.finish_reason == "length" and len(res3.output_tokens) == 4
+
+
+def test_warmup_precompiles_and_leaves_engine_clean(tiny):
+    _, params, cfg = tiny
+    eng = _make_engine(params, cfg)
+    eng.warmup()
+    assert not eng.has_work()
+    assert eng._allocator.free_count == eng._allocator.num_pages
+    # normal traffic after warmup behaves identically to a fresh engine
+    prompt = [1, 2, 3, 4]
+    sp = SamplingParams(max_tokens=5, temperature=0.0, stop_token_ids=())
+    out = eng.generate([prompt], sp)[0].output_tokens
+    ref = _make_engine(params, cfg).generate([prompt], sp)[0].output_tokens
+    assert out == ref

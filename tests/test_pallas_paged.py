@@ -84,3 +84,118 @@ def test_engine_with_pallas_path_matches_hf():
         ref = model.generate(torch.tensor([prompt]), max_new_tokens=6, do_sample=False,
                              pad_token_id=0, eos_token_id=None)
     assert res.output_tokens == ref[0, len(prompt):].tolist()
+
+
+# ------------------------------------------------- staged burst kernel ----
+
+
+def _staged_case(seed, b, n_q, n_kv, hd, ps, num_pages, max_pages, pool_lens,
+                 n_steps, staged_len):
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(b, 1, n_q, hd)).astype(np.float32)
+    k_pages = rng.normal(size=(n_kv, num_pages, ps, hd)).astype(np.float32)
+    v_pages = rng.normal(size=(n_kv, num_pages, ps, hd)).astype(np.float32)
+    staged_k = rng.normal(size=(b, n_kv, n_steps, hd)).astype(np.float32)
+    staged_v = rng.normal(size=(b, n_kv, n_steps, hd)).astype(np.float32)
+    perm = rng.permutation(num_pages)
+    block_tables = np.zeros((b, max_pages), dtype=np.int32)
+    taken = 0
+    for row in range(b):
+        need = -(-int(pool_lens[row]) // ps) if pool_lens[row] else 0
+        block_tables[row, :need] = perm[taken : taken + need]
+        taken += need
+    return (jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(block_tables), jnp.asarray(pool_lens, dtype=jnp.int32),
+            jnp.asarray(staged_k), jnp.asarray(staged_v),
+            jnp.asarray([staged_len], dtype=jnp.int32))
+
+
+def _staged_oracle(q, k_pages, v_pages, block_tables, pool_lens, staged_k,
+                   staged_v, staged_len):
+    """gather pool + concat staged tail + masked dense attention — the same
+    math the decode burst's CPU path runs."""
+    from githubrepostorag_tpu.ops.attention import dense_attention
+    from githubrepostorag_tpu.ops.paged_attention import gather_kv
+
+    b = q.shape[0]
+    n_steps = staged_k.shape[2]
+    pool_k, pool_v = gather_kv(k_pages, v_pages, block_tables)
+    pool_valid = jnp.arange(pool_k.shape[1])[None, :] < pool_lens[:, None]
+    staged_valid = jnp.broadcast_to(
+        (jnp.arange(n_steps) < staged_len[0])[None, :], (b, n_steps)
+    )
+    k_all = jnp.concatenate([pool_k, staged_k.swapaxes(1, 2)], axis=1)
+    v_all = jnp.concatenate([pool_v, staged_v.swapaxes(1, 2)], axis=1)
+    valid = jnp.concatenate([pool_valid, staged_valid], axis=1)
+    return dense_attention(q, k_all, v_all, causal=False, kv_valid=valid)
+
+
+@pytest.mark.parametrize("pool_lens,staged_len", [
+    ([50, 7, 0, 33], 3),   # ragged pools incl. empty, mid-burst
+    ([0, 0, 0, 0], 1),     # burst step 0 right after prefill-free start
+    ([64, 64, 64, 64], 8), # full pools, full staged tail
+])
+def test_staged_kernel_matches_oracle(pool_lens, staged_len):
+    from githubrepostorag_tpu.ops.pallas_paged import paged_attention_decode_staged
+
+    args = _staged_case(0, 4, 8, 2, 64, 16, 32, 4, pool_lens, 8, staged_len)
+    ref = _staged_oracle(*args)
+    out = paged_attention_decode_staged(*args, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_staged_kernel_gqa_group_seven():
+    from githubrepostorag_tpu.ops.pallas_paged import paged_attention_decode_staged
+
+    args = _staged_case(3, 2, 28, 4, 64, 16, 24, 6, [80, 42], 16, 11)
+    ref = _staged_oracle(*args)
+    out = paged_attention_decode_staged(*args, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_burst_pallas_matches_gather_path():
+    """decode_burst(use_pallas=True) must be token-identical to the gather
+    oracle path on the same inputs (greedy, so no sampling noise)."""
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+    from githubrepostorag_tpu.serving.decode_burst import decode_burst
+    from githubrepostorag_tpu.serving.kv_cache import make_page_pools
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    b, num_pages, page_size, n_steps = 2, 16, 4, 6
+    max_pages = 8
+
+    rng = np.random.default_rng(0)
+    seq_lens = np.asarray([5, 3], dtype=np.int32)
+    bt = np.zeros((b, max_pages), dtype=np.int32)
+    bt[0] = np.arange(8); bt[1] = np.arange(8, 16)
+    last = np.asarray([4, 7], dtype=np.int32)
+
+    outs = {}
+    for use_pallas in (False, True):
+        pools = make_page_pools(cfg, num_pages, page_size, dtype=jnp.float32)
+        # identical pool contents for both paths
+        rng2 = np.random.default_rng(42)
+        k_init = jnp.asarray(rng2.standard_normal(pools.k.shape), dtype=jnp.float32)
+        v_init = jnp.asarray(rng2.standard_normal(pools.v.shape), dtype=jnp.float32)
+        toks, valid, k_out, v_out, _, out_lens = decode_burst(
+            params, cfg,
+            jnp.asarray(last), jnp.asarray(seq_lens),
+            k_init, v_init,
+            jnp.zeros((b, cfg.vocab_size), dtype=bool),
+            jnp.ones((b,), dtype=bool),
+            jnp.full((b,), 30, dtype=jnp.int32),
+            jnp.asarray(bt), jax.random.PRNGKey(5),
+            jnp.zeros((b,)), jnp.ones((b,)), jnp.zeros((b,), jnp.int32),
+            jnp.ones((b,)),
+            n_steps=n_steps, use_pallas=use_pallas,
+        )
+        outs[use_pallas] = (np.asarray(toks), np.asarray(valid),
+                            np.asarray(k_out), np.asarray(v_out),
+                            np.asarray(out_lens))
+
+    np.testing.assert_array_equal(outs[False][0], outs[True][0])  # tokens
+    np.testing.assert_array_equal(outs[False][1], outs[True][1])  # valid
+    np.testing.assert_allclose(outs[False][2], outs[True][2], atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(outs[False][3], outs[True][3], atol=1e-5, rtol=1e-5)
+    np.testing.assert_array_equal(outs[False][4], outs[True][4])
