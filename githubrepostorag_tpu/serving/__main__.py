@@ -30,11 +30,30 @@ async def serve(host: str, port: int) -> None:
     from githubrepostorag_tpu.serving.openai_api import OpenAIServer
     from githubrepostorag_tpu.serving.tokenizer import HFTokenizer
 
+    from githubrepostorag_tpu.parallel import MeshPlan, make_mesh, plan_for_devices
+
     s = get_settings()
     if not s.model_weights_path:
         raise SystemExit("model server requires MODEL_WEIGHTS_PATH (a local HF checkpoint dir)")
     logger.info("loading weights from %s", s.model_weights_path)
     params, cfg = load_qwen2(s.model_weights_path, dtype=ml_dtypes.bfloat16)
+
+    # TP-shard the decoder over the chip's ICI mesh (vLLM's
+    # --tensor-parallel-size equivalent; reference runs TP=1 on one GPU —
+    # helm/templates/qwen-deployment.yaml:44-46)
+    n = len(jax.devices())
+    plan = plan_for_devices(
+        n, num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads, role="serve"
+    )
+    mesh = make_mesh(MeshPlan(tp=plan.tp)) if plan.tp > 1 else None
+    if mesh is not None:
+        logger.info("tensor-parallel serving over tp=%d of %d devices", plan.tp, n)
+        if plan.tp < n:
+            logger.info(
+                "%d devices idle (DP serving = one engine replica per group; "
+                "run more server pods to use them)", n - plan.tp
+            )
+
     engine = Engine(
         params, cfg,
         max_num_seqs=s.max_num_seqs,
@@ -43,6 +62,7 @@ async def serve(host: str, port: int) -> None:
         max_seq_len=s.context_window,
         prefill_chunk=s.prefill_chunk,
         use_pallas=jax.default_backend() == "tpu",
+        mesh=mesh,
     )
     logger.info("precompiling engine programs (prefill buckets + decode burst)")
     engine.warmup()

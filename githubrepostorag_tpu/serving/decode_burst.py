@@ -40,6 +40,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from githubrepostorag_tpu.models.qwen2 import Qwen2Config, _block, _logits
 from githubrepostorag_tpu.ops.attention import dense_attention
@@ -49,9 +50,40 @@ from githubrepostorag_tpu.ops.rope import rope_cos_sin
 from githubrepostorag_tpu.ops.sampling import sample_tokens_capped
 
 
+def _staged_attend_tp(mesh, interpret):
+    """The Pallas staged kernel wrapped in a shard_map island for tensor
+    parallelism: attention is embarrassingly parallel over kv heads, so each
+    tp shard runs the kernel on its local heads (q [B,1,nq/tp,hd], pools
+    [n_kv/tp,...]) with zero collectives — GSPMD handles the dense program
+    around it and inserts the row-parallel psums after wo/wd."""
+    from jax.experimental.shard_map import shard_map
+
+    def call(q, kp, vp, bt, pool_lens, sk, sv, staged_len):
+        return paged_attention_decode_staged(
+            q, kp, vp, bt, pool_lens, sk, sv, staged_len, interpret=interpret
+        )
+
+    return shard_map(
+        call,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, "tp", None),   # q over heads
+            P("tp", None, None, None),   # k_pages over kv heads
+            P("tp", None, None, None),   # v_pages
+            P(None, None),               # block tables replicated
+            P(None),                     # pool lens replicated
+            P(None, "tp", None, None),   # staged k over kv heads
+            P(None, "tp", None, None),   # staged v
+            P(None),                     # staged_len replicated
+        ),
+        out_specs=P(None, None, "tp", None),
+        check_rep=False,
+    )
+
+
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "use_pallas"),
+    static_argnames=("cfg", "n_steps", "use_pallas", "mesh"),
     donate_argnums=(4, 5, 6),
 )
 def decode_burst(
@@ -72,6 +104,7 @@ def decode_burst(
     repetition_penalty: jnp.ndarray,  # [B]
     n_steps: int,
     use_pallas: bool = False,
+    mesh=None,  # jax.sharding.Mesh with a tp axis -> TP-sharded attention
 ):
     """Run ``n_steps`` decode iterations for every active row.
 
@@ -118,13 +151,17 @@ def decode_burst(
                 return jax.vmap(write)(sk, k_t), jax.vmap(write)(sv, v_t)
 
             if use_pallas:
+                interpret = jax.default_backend() != "tpu"
+                if mesh is not None and mesh.shape.get("tp", 1) > 1:
+                    kernel = _staged_attend_tp(mesh, interpret)
+                else:
+                    kernel = partial(paged_attention_decode_staged, interpret=interpret)
 
                 def attend(q, k_new, v_new):
                     sk2, sv2 = stage(sk, sv, k_new, v_new)
-                    out = paged_attention_decode_staged(
+                    out = kernel(
                         q, kp, vp, block_tables, start_lens, sk2, sv2,
-                        staged_len=jnp.reshape(step + 1, (1,)),
-                        interpret=jax.default_backend() != "tpu",
+                        jnp.reshape(step + 1, (1,)),
                     )
                     return out, (sk2, sv2)
 

@@ -98,7 +98,26 @@ class Engine:
         use_pallas: bool = False,
         rng_seed: int = 0,
         decode_burst: int = 8,
+        mesh=None,  # jax.sharding.Mesh -> TP-shard params, KV pools, compute
     ) -> None:
+        self.mesh = mesh
+        if mesh is not None:
+            from githubrepostorag_tpu.parallel.sharding import (
+                qwen2_param_specs,
+                shard_params,
+            )
+
+            tp = mesh.shape.get("tp", 1)
+            if tp > 1 and (cfg.num_kv_heads % tp or cfg.num_heads % tp):
+                # the Pallas shard_map island hard-shards the head dims; fail
+                # at construction, not mid-first-request (plan_for_devices
+                # caps tp by the head counts — direct mesh builders must too)
+                raise ValueError(
+                    f"tp={tp} must divide num_heads={cfg.num_heads} and "
+                    f"num_kv_heads={cfg.num_kv_heads}; use plan_for_devices("
+                    "..., num_heads=..., num_kv_heads=..., role='serve')"
+                )
+            params = shard_params(params, mesh, qwen2_param_specs(cfg, mesh))
         self.params = params
         self.cfg = cfg
         self.max_num_seqs = max_num_seqs
@@ -113,6 +132,14 @@ class Engine:
 
         pools = make_page_pools(cfg, num_pages, page_size, dtype=kv_dtype)
         self._k_pages, self._v_pages = pools.k, pools.v
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as PS
+
+            kv_tp = "tp" if mesh.shape.get("tp", 1) > 1 else None
+            kv_sharding = NamedSharding(mesh, PS(None, kv_tp, None, None, None))
+            self._k_pages = jax.device_put(self._k_pages, kv_sharding)
+            self._v_pages = jax.device_put(self._v_pages, kv_sharding)
+            self._replicated = NamedSharding(mesh, PS())
         self._allocator = PageAllocator(num_pages)
 
         # host-side batch state
@@ -132,6 +159,8 @@ class Engine:
 
         # token-presence mask for repetition penalty [rows, V]
         self._presence = jnp.zeros((max_num_seqs, cfg.vocab_size), dtype=bool)
+        if mesh is not None:
+            self._presence = jax.device_put(self._presence, self._replicated)
 
         self._rng = jax.random.PRNGKey(rng_seed)
         self._waiting: list[_Request] = []
@@ -436,7 +465,7 @@ class Engine:
             jnp.asarray(active), jnp.asarray(self._row_limits),
             jnp.asarray(self._block_tables), key,
             self._temp_d, self._top_p_d, self._top_k_d, self._rep_pen_d,
-            n_steps=n_steps, use_pallas=self.use_pallas,
+            n_steps=n_steps, use_pallas=self.use_pallas, mesh=self.mesh,
         )
         prev = self._chain["pending"] if self._chain is not None else None
         self._chain = {"last": toks[:, -1], "lens": out_lens, "pending": toks}

@@ -150,3 +150,37 @@ def test_train_loss_identical_across_mesh_shapes():
         _, _, loss = step(state.params, state.opt_state, batch)
         vals.append(float(loss))
     assert abs(vals[0] - vals[1]) < 1e-3
+
+
+# ------------------------------------------------- TP-sharded serving ----
+
+
+def _greedy_engine_tokens(params, cfg, mesh, use_pallas):
+    from githubrepostorag_tpu.serving import Engine, SamplingParams
+
+    eng = Engine(params, cfg, max_num_seqs=2, num_pages=32, page_size=4,
+                 max_seq_len=64, prefill_chunk=32, kv_dtype=jnp.float32,
+                 use_pallas=use_pallas, decode_burst=8, mesh=mesh)
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    sp = SamplingParams(max_tokens=10, temperature=0.0, stop_token_ids=())
+    return [r.output_tokens for r in eng.generate(prompts, sp)]
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_tp2_sharded_decode_token_identical(use_pallas):
+    """TP=2 sharded serving (params, KV pools, and — on the pallas path —
+    the staged kernel inside a shard_map island) must produce exactly the
+    single-device greedy tokens.  vLLM --tensor-parallel-size equivalent."""
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+
+    cfg = Qwen2Config.tiny()  # 4 q heads / 2 kv heads -> tp=2 divides both
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    ref = _greedy_engine_tokens(params, cfg, None, use_pallas)
+    mesh = make_mesh(MeshPlan(tp=2))
+    out = _greedy_engine_tokens(params, cfg, mesh, use_pallas)
+    assert out == ref
+
+
+def test_serve_plan_caps_tp_by_kv_heads():
+    plan = plan_for_devices(8, num_heads=4, num_kv_heads=2, role="serve")
+    assert plan.tp == 2 and plan.dp == 4
