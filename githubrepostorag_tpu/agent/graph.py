@@ -268,7 +268,7 @@ class GraphAgent:
                          filters=dict(state.filters))
         return "retry"
 
-    def synthesize(self, state: AgentState) -> None:
+    def synthesize(self, state: AgentState, token_cb: Callable[[str], None] | None = None) -> None:
         # Two robustness improvements over the reference, which synthesizes
         # over whatever the LAST retrieve returned (possibly nothing): fall
         # back to the best non-empty retrieval of the run, and as a last
@@ -309,9 +309,28 @@ class GraphAgent:
         overview = any(term in ql for term in _OVERVIEW_TERMS)
         has_content = any(len(b.split("\n", 1)[-1].strip()) > 50 for b in blocks)
 
-        text = self.llm.complete(
-            prompts.synthesis_prompt(state.original_query, blocks, overview and has_content)
+        synth_prompt = prompts.synthesis_prompt(
+            state.original_query, blocks, overview and has_content
         )
+        if token_cb is None:
+            text = self.llm.complete(synth_prompt)
+        else:
+            # real token streaming into the job event path — the reference
+            # promised this and faked it (qwen_llm.py:149-151 returns the
+            # whole completion as one "stream" chunk)
+            from githubrepostorag_tpu.llm import postprocess_completion
+
+            pieces: list[str] = []
+            for delta in self.llm.stream_complete(synth_prompt):
+                pieces.append(delta)
+                if token_cb is not None:
+                    try:
+                        token_cb(delta)
+                    except Exception:  # noqa: BLE001 - streaming must not kill the run
+                        token_cb = None
+            # same post-processing as the non-streamed path, so the stored
+            # answer is identical whether or not a consumer streamed it
+            text = postprocess_completion(synth_prompt, "".join(pieces))
 
         # anti-conservative retry (agent_graph.py:489-503)
         if has_content and len(docs) >= 3 and _sounds_conservative(text):
@@ -319,6 +338,8 @@ class GraphAgent:
                 prompts.encouraging_synthesis_prompt(state.original_query, blocks)
             )
             if retry_text and not _sounds_conservative(retry_text):
+                # replaces the streamed draft; "final" is authoritative and
+                # incremental consumers re-render from it
                 text = retry_text
                 state.debug["synthesis_retry"] = "overcame_conservative_answer"
             else:
@@ -347,6 +368,7 @@ class GraphAgent:
         progress_cb: ProgressCallback | None = None,
         force_level: str | None = None,
         should_stop: Callable[[], bool] | None = None,
+        token_cb: Callable[[str], None] | None = None,
     ) -> AgentResult:
         state = AgentState(query=question, original_query=question, progress_cb=progress_cb)
         if namespace or self.namespace:
@@ -370,7 +392,7 @@ class GraphAgent:
             if self.rewrite_or_end(state) == "synthesize":
                 break
         check_cancel()
-        self.synthesize(state)
+        self.synthesize(state, token_cb=token_cb)
         return AgentResult(answer=state.answer or "", sources=state.sources, debug=state.debug)
 
     # ------------------------------------------------------------ helpers

@@ -26,6 +26,7 @@ import numpy as np
 from githubrepostorag_tpu.config import get_settings
 from githubrepostorag_tpu.utils import next_bucket
 from githubrepostorag_tpu.utils.logging import get_logger
+from githubrepostorag_tpu.utils.profiling import annotate
 
 logger = get_logger(__name__)
 
@@ -84,14 +85,28 @@ class JaxBertTextEncoder:
         max_length: int = 512,
         batch_size: int = 64,
         e5_prefixes: bool = True,
+        mesh=None,  # jax.sharding.Mesh with a dp axis -> data-parallel batches
     ) -> None:
-        self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.max_length = max_length
         self.batch_size = batch_size
         self.e5_prefixes = e5_prefixes
         self.dim = cfg.hidden_size
+        self.mesh = mesh
+        self._dp = mesh.shape.get("dp", 1) if mesh is not None else 1
+        if mesh is not None:
+            # ~33M params: replicate everywhere, shard the BATCH over dp
+            # (parallel/sharding.py encoder_param_specs; SURVEY.md §2.3 row
+            # "Data parallel — ingest embedding")
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.params = jax.device_put(params, NamedSharding(mesh, P()))
+            self._batch_sharding = NamedSharding(mesh, P("dp", None))
+        else:
+            self.params = params
+            self._batch_sharding = None
 
     @classmethod
     def from_pretrained(cls, model_dir: str, **kw) -> "JaxBertTextEncoder":
@@ -150,12 +165,21 @@ class JaxBertTextEncoder:
             # bucket the row dim too: distinct partial-batch sizes must not
             # each compile a fresh XLA program
             rows = next_bucket(len(idx), self.batch_size, minimum=8)
+            if rows % self._dp:  # dp-sharded batches must divide evenly
+                rows = -(-rows // self._dp) * self._dp
             ids = np.zeros((rows, bucket), dtype=np.int32)
             mask = np.zeros((rows, bucket), dtype=np.int32)
             for row, toks in enumerate(enc["input_ids"]):
                 ids[row, : len(toks)] = toks
                 mask[row, : len(toks)] = 1
-            vecs = embed(self.params, self.cfg, jnp.asarray(ids), jnp.asarray(mask))
+            ids_d, mask_d = jnp.asarray(ids), jnp.asarray(mask)
+            if self._batch_sharding is not None:
+                import jax
+
+                ids_d = jax.device_put(ids_d, self._batch_sharding)
+                mask_d = jax.device_put(mask_d, self._batch_sharding)
+            with annotate("encoder.embed_batch"):
+                vecs = embed(self.params, self.cfg, ids_d, mask_d)
             out[idx] = np.asarray(vecs)[: len(idx)]
         return out
 
@@ -172,8 +196,18 @@ def get_encoder() -> TextEncoder:
 
         model = get_settings().embed_model
         if model and os.path.isdir(model):
-            _encoder = JaxBertTextEncoder.from_pretrained(model)
-            logger.info("embedding: JAX BERT encoder from %s", model)
+            import jax
+
+            mesh = None
+            if jax.device_count() > 1:
+                from githubrepostorag_tpu.parallel import make_mesh, plan_for_devices
+
+                mesh = make_mesh(plan_for_devices(jax.device_count(), role="ingest"))
+            _encoder = JaxBertTextEncoder.from_pretrained(model, mesh=mesh)
+            logger.info(
+                "embedding: JAX BERT encoder from %s (dp=%d)",
+                model, mesh.shape["dp"] if mesh else 1,
+            )
         else:
             _encoder = HashingTextEncoder()
             logger.warning(

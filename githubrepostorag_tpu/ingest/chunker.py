@@ -2,21 +2,32 @@
 
 Fills the role of the reference's tree-sitter CodeSplitter
 (langauge_detector.py:76-137: chunk_lines=200, max_chars=4000, overlap 10
-lines, with a SentenceSplitter(4000/200) fallback).  tree-sitter isn't in
-this image, so code is split structurally at top-level definition
-boundaries found by per-language-family regexes, then greedily packed under
-the same line/char budgets; a real tree-sitter backend can slot in behind
-``split_code`` later without changing callers.
+lines, with a SentenceSplitter(4000/200) fallback).  Three AST/boundary
+backends behind one ``split_code`` seam, resolved per call:
 
-Text chunking mirrors the catalog pipeline's SentenceSplitter(1500/100)
-(catalog_pipeline.py:17-18): paragraph-first packing with character budgets
-and overlap.
+  - ``treesitter`` — real tree-sitter grammars via the
+    ``tree_sitter_language_pack`` C library when installed (the reference's
+    idiomatic choice, kept per SURVEY.md §2.2); top-level AST node starts
+    become chunk boundaries.
+  - ``pyast``      — stdlib ``ast`` for Python sources: true AST boundaries
+    (top-level statements + class-body methods, decorators glued) with zero
+    native deps.
+  - ``regex``      — per-language-family unindented-definition patterns;
+    the documented fallback, mirroring create_code_splitter_safely's
+    SentenceSplitter degradation (langauge_detector.py:115-137).
+
+All backends feed the same greedy packer under the same line/char budgets,
+so chunk semantics (200 lines / 4000 chars / 10-line overlap) are backend
+-independent.  Text chunking mirrors the catalog pipeline's
+SentenceSplitter(1500/100) (catalog_pipeline.py:17-18): paragraph-first
+packing with character budgets and overlap.
 """
 
 from __future__ import annotations
 
 import re
 from dataclasses import dataclass
+from functools import lru_cache
 
 CODE_CHUNK_LINES = 200
 CODE_CHUNK_CHARS = 4000
@@ -72,8 +83,8 @@ _FAMILY = {
 }
 
 
-def _boundaries(lines: list[str], language: str | None) -> list[int]:
-    """Indices where a new top-level unit starts."""
+def _regex_boundaries(lines: list[str], language: str | None) -> list[int]:
+    """Indices where a new top-level unit starts (regex fallback backend)."""
     pattern = _BOUNDARY_PATTERNS.get(_FAMILY.get(language or "", ""), _BOUNDARY_PATTERNS["generic"])
     bounds = [0]
     for i, line in enumerate(lines[1:], start=1):
@@ -88,17 +99,93 @@ def _boundaries(lines: list[str], language: str | None) -> list[int]:
     return sorted(set(bounds))
 
 
+def _pyast_boundaries(text: str, lines: list[str]) -> list[int] | None:
+    """True-AST boundaries for Python via the stdlib parser: every top-level
+    statement starts a unit (decorators glued to their def), and class-body
+    functions add sub-boundaries so large classes pack method-by-method
+    instead of being window-split.  Returns None on syntax errors (py2 code,
+    templates) so the caller degrades to the regex backend."""
+    import ast
+
+    try:
+        tree = ast.parse(text)
+    except (SyntaxError, ValueError):
+        return None
+    bounds = {0}
+
+    def start_line(node) -> int:
+        deco = getattr(node, "decorator_list", None)
+        if deco:
+            return min(d.lineno for d in deco) - 1
+        return node.lineno - 1
+
+    for node in tree.body:
+        bounds.add(start_line(node))
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    bounds.add(start_line(item))
+    return sorted(b for b in bounds if 0 <= b < len(lines))
+
+
+@lru_cache(maxsize=64)
+def _treesitter_parser(language: str):
+    """A tree-sitter parser for ``language``, or None when the C library /
+    grammar pack isn't installed (it isn't in this image; deployments that
+    add ``tree-sitter-language-pack`` get real grammars with no code
+    change)."""
+    try:  # pragma: no cover - exercised only when the native lib exists
+        from tree_sitter_language_pack import get_parser
+
+        return get_parser(language)
+    except Exception:  # noqa: BLE001 - any failure means "backend unavailable"
+        return None
+
+
+def _treesitter_boundaries(text: str, lines: list[str], language: str) -> list[int] | None:
+    parser = _treesitter_parser(language)
+    if parser is None:
+        return None
+    try:  # pragma: no cover - native-lib only
+        tree = parser.parse(text.encode("utf-8"))
+    except Exception:  # noqa: BLE001
+        return None
+    bounds = {0}
+    for node in tree.root_node.children:  # pragma: no cover - native-lib only
+        bounds.add(node.start_point[0])
+    return sorted(b for b in bounds if 0 <= b < len(lines))
+
+
+def _boundaries(text: str, lines: list[str], language: str | None, backend: str) -> list[int]:
+    """Resolve the chunking backend: explicit name, or ``auto`` =
+    treesitter -> pyast (python) -> regex."""
+    if backend in ("auto", "treesitter") and language:
+        ts = _treesitter_boundaries(text, lines, language)
+        if ts is not None:
+            return ts
+        if backend == "treesitter":
+            raise RuntimeError(f"tree-sitter backend unavailable for {language!r}")
+    if backend in ("auto", "pyast") and language == "python":
+        py = _pyast_boundaries(text, lines)
+        if py is not None:
+            return py
+        if backend == "pyast":
+            return _regex_boundaries(lines, language)  # documented degradation
+    return _regex_boundaries(lines, language)
+
+
 def split_code(
     text: str,
     language: str | None = None,
     max_lines: int = CODE_CHUNK_LINES,
     max_chars: int = CODE_CHUNK_CHARS,
     overlap_lines: int = CODE_OVERLAP_LINES,
+    backend: str = "auto",
 ) -> list[Chunk]:
     lines = text.splitlines()
     if not lines:
         return []
-    bounds = _boundaries(lines, language)
+    bounds = _boundaries(text, lines, language, backend)
     bounds.append(len(lines))
 
     # segments between structural boundaries

@@ -61,10 +61,13 @@ def _push_stage_gauge(stage: str, seconds: float, grouping: dict[str, str]) -> N
 @contextmanager
 def stage_timer(stage: str, grouping: dict[str, str], timings: dict[str, float],
                 on_stage: StageCallback | None = None):
+    from githubrepostorag_tpu.utils.profiling import annotate
+
     start = time.monotonic()
     logger.info("stage %s: start", stage)
     try:
-        yield
+        with annotate(f"ingest.{stage}"):
+            yield
     finally:
         elapsed = time.monotonic() - start
         timings[stage] = round(elapsed, 3)

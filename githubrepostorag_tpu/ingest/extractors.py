@@ -90,6 +90,9 @@ def enrich_nodes(llm: LLM, nodes: Sequence[Node]) -> None:
         kws = [k.strip() for k in t.replace("\n", ",").split(",") if k.strip()][:10]
         if kws:
             n.metadata["keywords"] = ", ".join(kws)
-            n.metadata.setdefault("topics", kws[0].lower())
+            # every keyword becomes a topic: the sanitizer shreds the list
+            # into key:member entries so a topics=<any member> filter matches
+            # (reference: ShreddingTransformer, vector_write_service.py:118)
+            n.metadata.setdefault("topics", [k.lower() for k in kws])
 
     _run_stage(llm, nodes, "keywords", _keywords_prompt, apply_keywords, 80)

@@ -2,11 +2,11 @@
 
 Rebuild of vector_write_service.py: stable deterministic ids (idempotent
 re-ingest, :166-198), metadata sanitized to MAP<TEXT,TEXT> semantics with a
-per-scope allow-list plus keep-always keys (:28-98), list values flattened
-to comma-joined strings (the shredder's purpose — equality-join edges —
-is served by the flat string keys the retrievers traverse on), and batched
-writes of 128 (:110) with the embedding computed by the shared TPU batch
-encoder instead of per-row CPU torch.
+per-scope allow-list plus keep-always keys (:28-98), list metadata SHREDDED
+into per-member entries so equality filters match any member (the
+reference's ShreddingTransformer, :118,153) alongside a comma-joined
+display value, and batched writes of 128 (:110) with the embedding computed
+by the shared TPU batch encoder instead of per-row CPU torch.
 """
 
 from __future__ import annotations
@@ -18,6 +18,7 @@ from githubrepostorag_tpu.config import get_settings
 from githubrepostorag_tpu.embedding import TextEncoder, get_encoder
 from githubrepostorag_tpu.ingest.types import Node
 from githubrepostorag_tpu.store import Doc, VectorStore, get_store
+from githubrepostorag_tpu.store.base import SHREDDED_KEYS, shred_entry
 from githubrepostorag_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -38,24 +39,35 @@ SCOPE_ALLOWED: dict[str, set[str]] = {
 
 
 def sanitize_metadata(metadata: dict, scope: str) -> dict[str, str]:
-    """Flatten to str->str under the scope's allow-list."""
+    """Flatten to str->str under the scope's allow-list.  Shredded keys
+    (topics/keywords/tech_stack) additionally write one ``key:member -> 1``
+    entry per member, so an exact-match filter on e.g. ``topics=kafka``
+    matches a doc whose topics are [Kafka, Streams, Consumer]."""
     allowed = SCOPE_ALLOWED.get(scope, set()) | KEEP_ALWAYS
     out: dict[str, str] = {}
     for key, val in metadata.items():
         if key not in allowed or val is None:
             continue
+        members: list[str] | None = None
         if isinstance(val, str):
             s = val
+            if key in SHREDDED_KEYS:
+                members = [m for m in (p.strip() for p in val.split(",")) if m]
         elif isinstance(val, (int, float, bool)):
             s = str(val)
         elif isinstance(val, (list, tuple)):
             s = ", ".join(str(v) for v in val)
+            if key in SHREDDED_KEYS:
+                members = [str(v) for v in val]
         elif isinstance(val, dict):
             s = json.dumps(val, ensure_ascii=False, sort_keys=True)
         else:
             s = str(val)
-        if s:
-            out[key] = s
+        if not s:
+            continue
+        out[key] = s
+        for member in members or ():
+            out[shred_entry(key, member)] = "1"
     return out
 
 

@@ -39,11 +39,18 @@ def _is_selector_prompt(prompt: str) -> bool:
     return bool(_SELECTOR_RE.search(prompt)) or "Select the best option" in prompt
 
 
-def _postprocess(prompt: str, text: str) -> str:
+def postprocess_completion(prompt: str, text: str) -> str:
+    """The one completion post-processing pipeline (fence strip, CoT/role
+    sanitize, selector extraction) — used by every ``complete`` impl, and by
+    callers that assemble text from a raw token stream so streamed and
+    non-streamed answers can't drift."""
     text = sanitize_llm_text(strip_fences(text).strip()).strip()
     if _is_selector_prompt(prompt):
         return extract_choice(text)
     return text
+
+
+_postprocess = postprocess_completion
 
 
 class LLM(Protocol):

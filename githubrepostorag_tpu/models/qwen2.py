@@ -282,17 +282,25 @@ def forward_paged(
     cached_lens: jnp.ndarray,  # [B] tokens already in cache before this step
     new_lens: jnp.ndarray,  # [B] valid new tokens this step
     use_pallas: bool = False,
+    logits_at: jnp.ndarray | None = None,  # [B] per-row position, see below
 ):
     """Prefill-chunk or decode step over the paged KV cache.
 
     New K/V are scattered into the page pools at ``slot_mapping`` (padding
     slots are -1 and dropped), then attention runs over each row's block
-    table.  Returns (logits [B, S, V] float32, k_pages, v_pages) — the pools
-    are donated so XLA updates them in place.
+    table.  Returns (logits, k_pages, v_pages) — the pools are donated so
+    XLA updates them in place.
+
+    ``logits_at``: per-row chunk index at which to project logits, returning
+    [B, 1, V].  Without it logits cover every position ([B, S, V] float32 —
+    at prefill width x batch x vocab that is GBs of HBM; the serving engine
+    only ever needs each prompt's last position, vLLM's
+    "last-token-only logits" optimization).
     """
     return forward_paged_impl(
         params, cfg, input_ids, positions, k_pages, v_pages,
         slot_mapping, block_tables, cached_lens, new_lens, use_pallas,
+        logits_at=logits_at,
     )
 
 
@@ -308,6 +316,7 @@ def forward_paged_impl(
     cached_lens: jnp.ndarray,
     new_lens: jnp.ndarray,
     use_pallas: bool = False,
+    logits_at: jnp.ndarray | None = None,
 ):
     """Unjitted body of ``forward_paged`` so larger fused programs (the
     multi-step decode burst in serving/decode_burst.py) can inline it inside
@@ -352,4 +361,6 @@ def forward_paged_impl(
 
     h, (k_pages, v_pages) = jax.lax.scan(body, h, (params["layers"], k_pages, v_pages))
     h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
+    if logits_at is not None:
+        h = jnp.take_along_axis(h, logits_at[:, None, None], axis=1)  # [B, 1, d]
     return _logits(params, h), k_pages, v_pages

@@ -45,6 +45,7 @@ from githubrepostorag_tpu.serving.kv_cache import (
 )
 from githubrepostorag_tpu.serving.sampling_params import SamplingParams
 from githubrepostorag_tpu.utils.logging import get_logger
+from githubrepostorag_tpu.utils.profiling import annotate
 
 logger = get_logger(__name__)
 
@@ -176,8 +177,17 @@ class Engine:
         # unfetched result; ``_deferred`` holds finished rows whose pages
         # can't be recycled until the in-flight burst that still references
         # them has landed.
+        #
+        # Mixed prefill+decode: admissions do NOT drain the pipeline —
+        # deferred pages never re-enter the allocator while a burst is in
+        # flight, so a new request can only receive pages no in-flight
+        # computation references.  Prefill waves dispatch between bursts
+        # with no host sync: first tokens stay on device in
+        # ``_pending_first`` waves, get overlaid into the next burst's
+        # chained last/lens state, and commit with that burst's fetch.
         self._chain: dict | None = None
         self._deferred: list[tuple[int, list[int]]] = []
+        self._pending_first: list[tuple[jnp.ndarray, list[tuple[_Request, int]]]] = []
 
     # ------------------------------------------------------------- intake --
 
@@ -284,14 +294,22 @@ class Engine:
     def _try_prefill(self, finished: list[GenerationResult]) -> bool:
         """Admit every waiting request the pool can back, then run ONE
         batched prefill chunk over all prefilling rows.  Returns True if a
-        prefill chunk ran."""
-        wants_prefill = any(
-            r.state == "prefilling" for r in self._row_req.values()
-        ) or self._admission_feasible()
-        if wants_prefill:
-            # prefill mutates block tables / seq lens / presence rows that an
-            # in-flight decode burst snapshot still uses — land it first
-            self._drain_chain(finished)
+        prefill chunk ran.
+
+        Runs WITHOUT draining the decode pipeline: free rows/pages are by
+        construction unreferenced by any in-flight burst (finished rows sit
+        in ``_deferred`` until a drain).  The chain is drained only when the
+        head-of-queue request needs those deferred resources (see
+        _admission_feasible)."""
+        if self._waiting:
+            req0 = self._waiting[0]
+            need0 = pages_needed(
+                min(len(req0.prompt) + req0.sampling.max_tokens, self.max_seq_len),
+                self.page_size,
+            )
+            can_free = bool(self._free_rows) and self._allocator.free_count >= need0
+            if not can_free and self._admission_feasible():
+                self._drain_chain(finished)
         # admit as many waiting requests as rows + pages allow
         while self._waiting and self._free_rows:
             req = self._waiting[0]
@@ -327,7 +345,10 @@ class Engine:
         request.  Rows at different prompt offsets ride the same program via
         per-row positions / cached_lens / slot mappings; rows whose prompt
         completes this chunk get their first token sampled in one batched
-        call (a single device->host sync for the whole admission wave)."""
+        on-device call.  When decode is running, the sampled tokens are NOT
+        fetched — the wave is queued on device and commits with the next
+        burst, so admissions never stall running streams on a host sync."""
+        others_running = any(r.state == "running" for r in self._row_req.values())
         n = len(reqs)
         # Shape discipline: row count buckets to powers of two, width is
         # ALWAYS prefill_chunk.  Every distinct device shape is a multi-second
@@ -354,14 +375,20 @@ class Engine:
             cached[i] = start
             new_lens[i] = valid
 
-        logits, self._k_pages, self._v_pages = forward_paged(
-            self.params, self.cfg,
-            jnp.asarray(ids), jnp.asarray(pos),
-            self._k_pages, self._v_pages,
-            jnp.asarray(slots), jnp.asarray(bt),
-            jnp.asarray(cached), jnp.asarray(new_lens),
-            use_pallas=self.use_pallas,
-        )
+        # logits only at each row's last valid position: full-position
+        # prefill logits are [rb, width, V] float32 — GBs at 64 rows
+        last_idx = np.zeros((rb,), dtype=np.int32)
+        for i, v in enumerate(valids):
+            last_idx[i] = v - 1
+        with annotate("engine.prefill_batch"):
+            logits, self._k_pages, self._v_pages = forward_paged(
+                self.params, self.cfg,
+                jnp.asarray(ids), jnp.asarray(pos),
+                self._k_pages, self._v_pages,
+                jnp.asarray(slots), jnp.asarray(bt),
+                jnp.asarray(cached), jnp.asarray(new_lens),
+                use_pallas=self.use_pallas, logits_at=jnp.asarray(last_idx),
+            )
 
         # mark prompt tokens in the presence mask (repetition penalty input);
         # one batched scatter for the whole padded wave (padding rows have
@@ -389,17 +416,12 @@ class Engine:
         # sampling program always sees the full [rb] padded batch (one
         # compiled shape per row bucket); rows that aren't done sample too
         # but their tokens are discarded and their presence scatter masked.
-        last_idx = np.zeros((rb,), dtype=np.int32)
-        for i, v in enumerate(valids):
-            last_idx[i] = v - 1
         done_mask = np.zeros((rb,), dtype=bool)
         done_mask[done_idx] = True
 
         self._push_sampling()
         self._rng, key = jax.random.split(self._rng)
-        last_logits = jnp.take_along_axis(
-            logits, jnp.asarray(last_idx)[:, None, None], axis=1
-        )[:, 0]  # [rb, V]
+        last_logits = logits[:, 0]  # [rb, V] — logits_at already selected
         tokens_d = sample_tokens(
             last_logits, key,
             self._temp_d[row_d], self._top_p_d[row_d], self._top_k_d[row_d],
@@ -407,11 +429,17 @@ class Engine:
         )
         safe = jnp.where(jnp.asarray(done_mask), tokens_d, self.cfg.vocab_size)
         self._presence = _mark_presence_rows(self._presence, row_d, safe)
-        tokens = np.asarray(tokens_d)  # one sync for the whole wave
-        for i in done_idx:
-            req = reqs[i]
+        wave = [(reqs[i], i) for i in done_idx]
+        for req, _ in wave:
             req.state = "running"
-            self._commit_token(req, int(tokens[i]), finished)
+        if self._chain is None and not others_running:
+            # engine was otherwise idle: nothing to overlap the sync with,
+            # so commit immediately (best TTFT)
+            tokens = np.asarray(tokens_d)
+            for req, i in wave:
+                self._commit_token(req, int(tokens[i]), finished)
+        else:
+            self._pending_first.append((tokens_d, wave))
 
     def _decode_step(self, finished: list[GenerationResult]) -> None:
         """One decode dispatch: a fused burst of up to ``self.decode_burst``
@@ -429,7 +457,7 @@ class Engine:
         active = np.zeros((b,), dtype=bool)
         remaining = 1
         for row, req in self._row_req.items():
-            active[row] = True
+            active[row] = req.state == "running"  # mid-prefill rows sit out
             remaining = max(remaining, req.sampling.max_tokens - len(req.output))
         # ONE compiled burst shape: always decode_burst steps.  Overshoot
         # past a row's max_tokens is discarded at commit — with continuous
@@ -455,29 +483,71 @@ class Engine:
             last_d = self._chain["last"]
             lens_d = self._chain["lens"]
 
+        # overlay freshly-prefilled rows: their first token lives on device
+        # (uncommitted) and their cache length is the host-known prompt
+        # length — neither is in the chained state from the in-flight burst
+        first_waves = self._pending_first
+        self._pending_first = []
+        for tokens_d, wave in first_waves:
+            # skip requests released/cancelled since their wave was queued:
+            # their row is -1 (or reassigned), and a negative index would
+            # WRAP to the last row and corrupt an unrelated request
+            live = [(req, i) for req, i in wave if req.state == "running" and req.row >= 0]
+            if not live:
+                continue
+            rows = jnp.asarray(np.asarray([req.row for req, _ in live], dtype=np.int32))
+            idxs = jnp.asarray(np.asarray([i for _, i in live], dtype=np.int32))
+            lens = jnp.asarray(
+                np.asarray([self._seq_lens[req.row] for req, _ in live], dtype=np.int32)
+            )
+            last_d = last_d.at[rows].set(tokens_d[idxs])
+            lens_d = lens_d.at[rows].set(lens)
+
         self._push_sampling()
         self._rng, key = jax.random.split(self._rng)
 
-        toks, valid, self._k_pages, self._v_pages, self._presence, out_lens = decode_burst(
-            self.params, self.cfg,
-            last_d, lens_d,
-            self._k_pages, self._v_pages, self._presence,
-            jnp.asarray(active), jnp.asarray(self._row_limits),
-            jnp.asarray(self._block_tables), key,
-            self._temp_d, self._top_p_d, self._top_k_d, self._rep_pen_d,
-            n_steps=n_steps, use_pallas=self.use_pallas, mesh=self.mesh,
-        )
-        prev = self._chain["pending"] if self._chain is not None else None
-        self._chain = {"last": toks[:, -1], "lens": out_lens, "pending": toks}
+        with annotate("engine.decode_burst"):
+            toks, valid, self._k_pages, self._v_pages, self._presence, out_lens = decode_burst(
+                self.params, self.cfg,
+                last_d, lens_d,
+                self._k_pages, self._v_pages, self._presence,
+                jnp.asarray(active), jnp.asarray(self._row_limits),
+                jnp.asarray(self._block_tables), key,
+                self._temp_d, self._top_p_d, self._top_k_d, self._rep_pen_d,
+                n_steps=n_steps, use_pallas=self.use_pallas, mesh=self.mesh,
+            )
+        prev = self._chain
+        self._chain = {
+            "last": toks[:, -1], "lens": out_lens, "pending": toks,
+            "first": first_waves,
+        }
         if prev is not None:
             self._commit_burst(prev, finished)
 
-    def _commit_burst(self, pending: jnp.ndarray, finished: list[GenerationResult]) -> None:
+    def _commit_first_tokens(
+        self,
+        waves: list[tuple[jnp.ndarray, list[tuple[_Request, int]]]],
+        finished: list[GenerationResult],
+    ) -> None:
+        """Fetch + commit deferred prefill first-token waves."""
+        for tokens_d, wave in waves:
+            tokens = None
+            for req, i in wave:
+                if req.state != "running" or req.output:
+                    continue  # cancelled/released, or already committed
+                if tokens is None:
+                    tokens = np.asarray(tokens_d)
+                self._commit_token(req, int(tokens[i]), finished)
+
+    def _commit_burst(self, entry: dict, finished: list[GenerationResult]) -> None:
         """Fetch a burst's packed tokens — ONE [B, n_steps] transfer, the
         single device->host round trip per burst — and apply stop/length
-        bookkeeping.  Position (row, i) holds -1 where the row was inactive;
-        rows already released ignore their tokens."""
-        toks = np.asarray(pending)  # [B, n_steps]
+        bookkeeping.  First-token waves attached to this burst (rows that
+        joined it fresh from prefill) commit before its tokens.  Position
+        (row, i) holds -1 where the row was inactive; rows already released
+        ignore their tokens."""
+        self._commit_first_tokens(entry.get("first", []), finished)
+        toks = np.asarray(entry["pending"])  # [B, n_steps]
         for i in range(toks.shape[1]):
             for row in sorted(self._row_req):
                 req = self._row_req.get(row)
@@ -488,12 +558,17 @@ class Engine:
                 self._commit_token(req, int(toks[row, i]), finished)
 
     def _drain_chain(self, finished: list[GenerationResult]) -> None:
-        """Land the in-flight burst (if any), commit its tokens, and recycle
-        every deferred row/page now that nothing on device references them."""
+        """Land the in-flight burst (if any), commit its tokens and any
+        deferred first-token waves, and recycle every deferred row/page now
+        that nothing on device references them."""
         if self._chain is not None:
-            pending = self._chain["pending"]
+            entry = self._chain
             self._chain = None  # releases during this commit recycle directly
-            self._commit_burst(pending, finished)
+            self._commit_burst(entry, finished)
+        if self._pending_first:
+            waves = self._pending_first
+            self._pending_first = []
+            self._commit_first_tokens(waves, finished)
         for row, pages in self._deferred:
             self._allocator.release(pages)
             self._free_rows.append(row)

@@ -33,10 +33,39 @@ class SearchHit:
     score: float  # cosine similarity in [-1, 1]
 
 
+# List-valued metadata keys are SHREDDED at write time (the reference's
+# ShreddingTransformer, vector_write_service.py:118,153): each member becomes
+# its own map entry ``key:member -> "1"`` so an equality filter matches ANY
+# member (Cassandra's entries(metadata_s) SAI index can only do equality).
+SHREDDED_KEYS = frozenset({"topics", "keywords", "tech_stack"})
+
+
+def shred_entry(key: str, member: str) -> str:
+    return f"{key}:{member.strip().lower()}"
+
+
+def filter_entries(flt: Mapping[str, str]) -> list[tuple[str, str]]:
+    """Translate a user filter to (map_key, value) equality pairs: shredded
+    keys match their per-member entries, everything else matches verbatim."""
+    out = []
+    for k, v in flt.items():
+        if k in SHREDDED_KEYS:
+            out.append((shred_entry(k, v), "1"))
+        else:
+            out.append((k, v))
+    return out
+
+
 def _match(metadata: Mapping[str, str], flt: Mapping[str, str] | None) -> bool:
     if not flt:
         return True
-    return all(metadata.get(k) == v for k, v in flt.items())
+    for k, v in flt.items():
+        if metadata.get(k) == v:
+            continue
+        if k in SHREDDED_KEYS and metadata.get(shred_entry(k, v)) == "1":
+            continue
+        return False
+    return True
 
 
 class VectorStore(abc.ABC):

@@ -18,7 +18,7 @@ from typing import Iterable, Mapping, Sequence
 
 import numpy as np
 
-from githubrepostorag_tpu.store.base import Doc, SearchHit, VectorStore
+from githubrepostorag_tpu.store.base import Doc, SearchHit, VectorStore, filter_entries
 
 try:  # pragma: no cover - exercised only with live infra
     from cassandra.auth import PlainTextAuthProvider
@@ -98,6 +98,17 @@ class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only
             self._session.execute(stmt, (doc.doc_id, doc.text, vec, dict(doc.metadata)))
         return len(docs)
 
+    @staticmethod
+    def _filter_variants(filter: Mapping[str, str]) -> list[list[tuple[str, str]]]:
+        """Equality-pair variants for a filter.  CQL has no OR, so shredded
+        keys (topics=kafka -> entry 'topics:kafka'='1') get a SECOND variant
+        using plain equality, tried only when the entry form matches nothing
+        — keeps rows ingested before shredding landed retrievable, matching
+        MemoryVectorStore._match's semantics."""
+        primary = filter_entries(filter)
+        plain = list(filter.items())
+        return [primary] if primary == plain else [primary, plain]
+
     def search(
         self,
         table: str,
@@ -106,40 +117,49 @@ class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only
         filter: Mapping[str, str] | None = None,
     ) -> list[SearchHit]:
         self._ensure_table(table)
-        where = ""
-        params: list = [[float(x) for x in np.asarray(query_vector).reshape(-1)]]
-        if filter:
-            clauses = []
-            for key, val in filter.items():
-                clauses.append("metadata_s[%s] = %s")
-                params.extend([key, val])
-            where = " WHERE " + " AND ".join(clauses)
-        params.append(int(k))
-        cql = (
-            f"SELECT row_id, body_blob, metadata_s, similarity_cosine(vector, %s) AS score "
-            f"FROM {self._ks}.{table}{where} ORDER BY vector ANN OF %s LIMIT %s"
-        )
-        # ANN OF needs the vector twice (score projection + ordering)
-        params.insert(-1, params[0])
-        rows = self._session.execute(cql, params)
-        return [
-            SearchHit(Doc(r.row_id, r.body_blob or "", dict(r.metadata_s or {})), float(r.score))
-            for r in rows
-        ]
+        vec = [float(x) for x in np.asarray(query_vector).reshape(-1)]
+        for pairs in self._filter_variants(filter) if filter else [[]]:
+            where = ""
+            params: list = [vec]
+            if pairs:
+                clauses = []
+                for key, val in pairs:
+                    clauses.append("metadata_s[%s] = %s")
+                    params.extend([key, val])
+                where = " WHERE " + " AND ".join(clauses)
+            params.append(int(k))
+            cql = (
+                f"SELECT row_id, body_blob, metadata_s, similarity_cosine(vector, %s) AS score "
+                f"FROM {self._ks}.{table}{where} ORDER BY vector ANN OF %s LIMIT %s"
+            )
+            # ANN OF needs the vector twice (score projection + ordering)
+            params.insert(-1, vec)
+            rows = self._session.execute(cql, params)
+            hits = [
+                SearchHit(Doc(r.row_id, r.body_blob or "", dict(r.metadata_s or {})), float(r.score))
+                for r in rows
+            ]
+            if hits:
+                return hits
+        return []
 
     def find_by_metadata(self, table: str, filter: Mapping[str, str], limit: int = 100) -> list[Doc]:
         self._ensure_table(table)
-        clauses, params = [], []
-        for key, val in filter.items():
-            clauses.append("metadata_s[%s] = %s")
-            params.extend([key, val])
-        params.append(int(limit))
-        cql = (
-            f"SELECT row_id, body_blob, metadata_s FROM {self._ks}.{table} "
-            f"WHERE {' AND '.join(clauses)} LIMIT %s"
-        )
-        rows = self._session.execute(cql, params)
-        return [Doc(r.row_id, r.body_blob or "", dict(r.metadata_s or {})) for r in rows]
+        for pairs in self._filter_variants(filter):
+            clauses, params = [], []
+            for key, val in pairs:
+                clauses.append("metadata_s[%s] = %s")
+                params.extend([key, val])
+            params.append(int(limit))
+            cql = (
+                f"SELECT row_id, body_blob, metadata_s FROM {self._ks}.{table} "
+                f"WHERE {' AND '.join(clauses)} LIMIT %s"
+            )
+            rows = self._session.execute(cql, params)
+            docs = [Doc(r.row_id, r.body_blob or "", dict(r.metadata_s or {})) for r in rows]
+            if docs:
+                return docs
+        return []
 
     def get(self, table: str, doc_id: str) -> Doc | None:
         self._ensure_table(table)

@@ -1,0 +1,52 @@
+"""jax.profiler integration (SURVEY.md §5.1).
+
+Two layers:
+  - ``annotate(name)`` — a TraceAnnotation context manager marking the hot
+    host-side regions (prefill dispatch, decode burst, embed batch, ingest
+    stages) so device traces carry semantic names.  Degrades to a no-op on
+    backends/builds without profiler support.
+  - ``maybe_trace()`` — env-gated whole-run capture: when
+    ``JAX_PROFILE_DIR`` is set, wraps the block in
+    jax.profiler.start_trace/stop_trace, producing a TensorBoard-loadable
+    trace (``tensorboard --logdir $JAX_PROFILE_DIR``).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager, nullcontext
+
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+PROFILE_DIR_ENV = "JAX_PROFILE_DIR"
+
+
+def annotate(name: str):
+    """TraceAnnotation for the named region; no-op if unsupported."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 - profiling must never break the path
+        return nullcontext()
+
+
+@contextmanager
+def maybe_trace():
+    """Capture a device trace for the enclosed block when JAX_PROFILE_DIR is
+    set (else no-op).  Usage: ``with maybe_trace(): run_workload()``."""
+    out_dir = os.environ.get(PROFILE_DIR_ENV)
+    if not out_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(out_dir)
+    logger.info("jax.profiler trace capture -> %s", out_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("jax.profiler trace written to %s", out_dir)

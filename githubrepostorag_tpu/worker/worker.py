@@ -130,12 +130,20 @@ class RagWorker:
                 self._safe_emit(job_id, "turn", event), loop
             )
 
+        def token_cb(delta: str) -> None:
+            # real token streaming through the bus (the reference faked it:
+            # qwen_llm.py:149-151); same thread -> loop hop as progress
+            asyncio.run_coroutine_threadsafe(
+                self._safe_emit(job_id, "token", {"text": delta}), loop
+            )
+
         try:
             result = await loop.run_in_executor(
                 None,
                 lambda: self.agent.run(
                     query, namespace=namespace, progress_cb=progress_cb,
                     force_level=force_level, should_stop=cancelled.is_set,
+                    token_cb=token_cb,
                 ),
             )
         except RunCancelled:

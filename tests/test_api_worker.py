@@ -209,3 +209,21 @@ def test_format_uptime():
     assert format_uptime(5) == "5s"
     assert format_uptime(3665) == "1h 1m 5s"
     assert format_uptime(90061) == "1d 1h 1m 1s"
+
+
+async def test_tokens_stream_before_final_in_job_sse():
+    """Real token streaming through the bus (reference faked it —
+    qwen_llm.py:149-151): a job's SSE stream must carry incremental `token`
+    events whose concatenation equals the `final` answer."""
+    async def body(session, base, api, worker):
+        resp = await session.post(f"{base}/rag/jobs", json={"query": "how are jobs created?"})
+        job_id = (await resp.json())["job_id"]
+        events = await _collect_events(session, base, job_id)
+        kinds = [e["event"] for e in events]
+        assert "token" in kinds
+        assert kinds[-1] == "final"
+        assert kinds.index("token") < kinds.index("final")
+        streamed = "".join(e["data"]["text"] for e in events if e["event"] == "token")
+        final = events[-1]["data"]["answer"]
+        assert streamed.strip() == final
+    await _with_service(body)

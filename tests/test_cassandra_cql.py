@@ -1,0 +1,209 @@
+"""CassandraVectorStore CQL-shape tests against a fake session.
+
+The reference shipped an audit INSERT that could never work (``?``
+placeholders on an unprepared statement — ingest_controller.py:419-435,
+failure swallowed); these tests pin the exact CQL text + parameter shapes
+of every statement this store issues so that class of bug cannot ship.
+Marked unit tests (no driver needed — the class is constructed without
+__init__); live-infra coverage is the ``integration`` marker below.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from githubrepostorag_tpu.store.cassandra import CassandraVectorStore
+from githubrepostorag_tpu.store.base import Doc
+
+
+class FakeResult:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def __iter__(self):
+        return iter(self._rows)
+
+    def one(self):
+        return self._rows[0] if self._rows else None
+
+
+class FakePrepared:
+    def __init__(self, cql):
+        self.cql = cql
+
+
+class FakeSession:
+    """Records every (cql, params) pair; scripted results by substring."""
+
+    def __init__(self):
+        self.calls: list[tuple[str, object]] = []
+        self.results: list[tuple[str, list]] = []  # (cql substring, rows)
+
+    def script(self, substring: str, rows: list) -> None:
+        self.results.append((substring, rows))
+
+    def prepare(self, cql: str) -> FakePrepared:
+        self.calls.append(("PREPARE", cql))
+        return FakePrepared(cql)
+
+    def execute(self, cql, params=None):
+        text = cql.cql if isinstance(cql, FakePrepared) else cql
+        self.calls.append((text, params))
+        for sub, rows in self.results:
+            if sub in text:
+                return FakeResult(rows)
+        return FakeResult([])
+
+
+def make_store(session=None) -> tuple[CassandraVectorStore, FakeSession]:
+    session = session or FakeSession()
+    store = CassandraVectorStore.__new__(CassandraVectorStore)
+    store._session = session
+    store._ks = "vector_store"
+    store._dim = 4
+    store._known_tables = set()
+    store._insert_stmts = {}
+    return store, session
+
+
+def executed(session, substring):
+    return [c for c in session.calls if substring in str(c[0])]
+
+
+def test_ensure_table_issues_schema_and_sai_indexes():
+    store, s = make_store()
+    store.upsert("embeddings", [])
+    ddl = [c[0] for c in s.calls]
+    assert any("CREATE TABLE IF NOT EXISTS vector_store.embeddings" in d for d in ddl)
+    table_ddl = next(d for d in ddl if "CREATE TABLE" in d)
+    for col in ("row_id TEXT PRIMARY KEY", "body_blob TEXT",
+                "vector VECTOR<FLOAT, 4>", "metadata_s MAP<TEXT, TEXT>"):
+        assert col in table_ddl
+    assert any("StorageAttachedIndex" in d and "(vector)" in d for d in ddl)
+    assert any("entries(metadata_s)" in d for d in ddl)
+
+
+def test_upsert_uses_prepared_statement_with_question_marks():
+    """Prepared statements take '?' placeholders; simple statements take
+    '%s' — mixing them is the reference's shipped bug class."""
+    store, s = make_store()
+    doc = Doc("id1", "hello", {"topics": "kafka"}, np.asarray([1, 2, 3, 4], dtype=np.float32))
+    assert store.upsert("embeddings", [doc]) == 1
+    prepare = next(c for c in s.calls if c[0] == "PREPARE")
+    assert prepare[1].count("?") == 4 and "%s" not in prepare[1]
+    exec_call = next(c for c in s.calls if isinstance(c[0], str) and c[0].startswith("INSERT"))
+    cql, params = exec_call
+    assert params == ("id1", "hello", [1.0, 2.0, 3.0, 4.0], {"topics": "kafka"})
+
+
+def test_unprepared_statements_use_percent_s_never_question_marks():
+    store, s = make_store()
+    store.get("embeddings", "id1")
+    store.count("embeddings")
+    store.delete("embeddings", ["id1"])
+    store.find_by_metadata("embeddings", {"repo": "svc"})
+    for cql, params in s.calls:
+        if isinstance(cql, str) and not cql.startswith(("CREATE", "PREPARE", "INSERT")):
+            assert "?" not in cql, f"unprepared statement with '?': {cql}"
+
+
+def test_search_ann_cql_shape_and_params():
+    store, s = make_store()
+    row = SimpleNamespace(row_id="r1", body_blob="text", metadata_s={"repo": "svc"}, score=0.9)
+    s.script("ORDER BY vector ANN OF", [row])
+    hits = store.search("embeddings", np.asarray([0.1, 0.2, 0.3, 0.4]), k=5,
+                        filter={"repo": "svc"})
+    cql, params = executed(s, "ANN OF")[0]
+    assert "similarity_cosine(vector, %s)" in cql
+    assert "WHERE metadata_s[%s] = %s" in cql
+    assert cql.endswith("ORDER BY vector ANN OF %s LIMIT %s")
+    # params: [vector, key, val, vector, k] — ANN OF needs the vector twice
+    assert params[0] == params[-2] == pytest.approx([0.1, 0.2, 0.3, 0.4])
+    assert params[1:3] == ["repo", "svc"] and params[-1] == 5
+    assert [h.doc.doc_id for h in hits] == ["r1"] and hits[0].score == pytest.approx(0.9)
+
+
+def test_search_shredded_topics_filter_uses_entry_form():
+    store, s = make_store()
+    row = SimpleNamespace(row_id="r1", body_blob="t", metadata_s={}, score=1.0)
+    s.script("topics:kafka", [row])
+    store.search("embeddings", np.asarray([0.0, 0.0, 0.0, 1.0]), k=3,
+                 filter={"topics": "Kafka"})
+    cql, params = executed(s, "ANN OF")[0]
+    assert params[1:3] == ["topics:kafka", "1"]  # lowered, entry-form
+
+
+def test_search_falls_back_to_plain_equality_for_preshred_rows():
+    """Rows ingested before shredding carry only metadata_s['topics']='kafka';
+    when the entry form matches nothing the store must retry with plain
+    equality instead of silently returning zero rows."""
+    store, s = make_store()
+    old_row = SimpleNamespace(row_id="old", body_blob="t", metadata_s={"topics": "kafka"}, score=1.0)
+
+    class TwoPhase(FakeSession):
+        def execute(self, cql, params=None):
+            text = cql.cql if isinstance(cql, FakePrepared) else cql
+            self.calls.append((text, params))
+            if "ANN OF" in text and params and "topics:kafka" in params:
+                return FakeResult([])  # entry form: no pre-shred rows
+            if "ANN OF" in text:
+                return FakeResult([old_row])
+            return FakeResult([])
+
+    store, s = make_store(TwoPhase())
+    hits = store.search("embeddings", np.asarray([0.0, 0.0, 0.0, 1.0]), k=3,
+                        filter={"topics": "kafka"})
+    assert [h.doc.doc_id for h in hits] == ["old"]
+    ann_calls = executed(s, "ANN OF")
+    assert len(ann_calls) == 2
+    assert "topics:kafka" in ann_calls[0][1] and "kafka" in ann_calls[1][1]
+
+
+def test_find_by_metadata_cql_and_fallback():
+    store, s = make_store()
+    row = SimpleNamespace(row_id="r2", body_blob="b", metadata_s={"module": "api"})
+    s.script("WHERE metadata_s", [row])
+    docs = store.find_by_metadata("embeddings", {"module": "api"}, limit=7)
+    cql, params = executed(s, "WHERE metadata_s")[0]
+    assert cql.startswith("SELECT row_id, body_blob, metadata_s FROM vector_store.embeddings")
+    assert params == ["module", "api", 7]
+    assert [d.doc_id for d in docs] == ["r2"]
+
+
+def test_delete_checks_existence_first():
+    store, s = make_store()
+    s.script("SELECT row_id FROM", [SimpleNamespace(row_id="a")])
+    n = store.delete("embeddings", ["a"])
+    assert n == 1
+    kinds = [c[0] for c in s.calls if isinstance(c[0], str)]
+    sel = next(i for i, c in enumerate(kinds) if c.startswith("SELECT row_id"))
+    dele = next(i for i, c in enumerate(kinds) if c.startswith("DELETE"))
+    assert sel < dele
+
+
+def test_health_probe_is_lightweight():
+    store, s = make_store()
+    s.script("system.local", [SimpleNamespace(release_version="5.0")])
+    s.script("system_schema.tables", [SimpleNamespace(table_name="embeddings")])
+    health = store.health()
+    assert health["status"] == "UP"
+    assert not executed(s, "COUNT(*)")  # liveness must not full-scan
+
+
+@pytest.mark.integration
+def test_live_cassandra_roundtrip():  # pragma: no cover - needs a container
+    """Run with ``pytest -m integration`` against a live Cassandra 5
+    (CASSANDRA_HOSTS env); exercises real DDL + SAI + ANN."""
+    import os
+
+    hosts = os.environ.get("CASSANDRA_HOSTS")
+    if not hosts:
+        pytest.skip("CASSANDRA_HOSTS not set")
+    store = CassandraVectorStore(hosts.split(","), embed_dim=4)
+    vec = np.asarray([1.0, 0.0, 0.0, 0.0], dtype=np.float32)
+    store.upsert("it_embeddings", [Doc("it1", "hello", {"topics": "kafka"}, vec)])
+    hits = store.search("it_embeddings", vec, k=1)
+    assert hits and hits[0].doc.doc_id == "it1"

@@ -109,3 +109,24 @@ def test_get_encoder_falls_back_to_hashing(monkeypatch):
     enc = embedding.get_encoder()
     assert isinstance(enc, HashingTextEncoder)
     embedding.set_encoder(None)
+
+
+def test_dp_sharded_encoder_matches_single_device(tiny_bert):
+    """Ingest batch embedding sharded over the dp mesh axis must produce the
+    same vectors as the unsharded path (SURVEY.md §2.3 data-parallel row)."""
+    from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
+
+    _, params, cfg = tiny_bert
+
+    class StubTokenizer:
+        def __call__(self, texts, **kw):
+            return {"input_ids": [[(ord(c) % 250) + 1 for c in t[:20]] for t in texts]}
+
+    texts = [f"document number {i} about things" for i in range(20)]
+    base = JaxBertTextEncoder(params, cfg, StubTokenizer(), max_length=64,
+                              batch_size=8, e5_prefixes=False)
+    mesh = make_mesh(MeshPlan(dp=8))
+    dp = JaxBertTextEncoder(params, cfg, StubTokenizer(), max_length=64,
+                            batch_size=8, e5_prefixes=False, mesh=mesh)
+    np.testing.assert_allclose(base.encode(texts), dp.encode(texts),
+                               atol=1e-5, rtol=1e-5)

@@ -266,3 +266,41 @@ def test_warmup_precompiles_and_leaves_engine_clean(tiny):
     out = eng.generate([prompt], sp)[0].output_tokens
     ref = _make_engine(params, cfg).generate([prompt], sp)[0].output_tokens
     assert out == ref
+
+
+def test_mid_decode_admission_keeps_pipeline(tiny):
+    """A request arriving while others decode must be admitted WITHOUT
+    draining the burst pipeline (free pages suffice), and every request's
+    greedy output must match a solo run."""
+    _, params, cfg = tiny
+    sp = SamplingParams(max_tokens=10, temperature=0.0, stop_token_ids=())
+    solo = {}
+    for prompt in ([1, 2, 3, 4], [9, 8, 7]):
+        eng = Engine(params, cfg, max_num_seqs=4, num_pages=64, page_size=4,
+                     max_seq_len=64, decode_burst=4)
+        solo[tuple(prompt)] = eng.generate([prompt], sp)[0].output_tokens
+
+    eng = Engine(params, cfg, max_num_seqs=4, num_pages=64, page_size=4,
+                 max_seq_len=64, decode_burst=4)
+    drains = []  # drains that happened with a request still waiting = stalls
+    orig = eng._drain_chain
+    eng._drain_chain = lambda fin: (
+        drains.append(len(eng._waiting)) if eng._waiting else None,
+        orig(fin),
+    )[1]
+
+    r1 = eng.add_request([1, 2, 3, 4], sp)
+    # a few steps so request 1 is mid-decode with a live chain
+    for _ in range(3):
+        eng.step()
+    assert eng._chain is not None
+    r2 = eng.add_request([9, 8, 7], sp)
+    done = {}
+    while eng.has_work():
+        for res in eng.step():
+            done[res.request_id] = res
+    assert done[r1].output_tokens == solo[(1, 2, 3, 4)]
+    assert done[r2].output_tokens == solo[(9, 8, 7)]
+    # the admission itself must not have drained a live pipeline: a drain
+    # while a request sat in the waiting queue means admission stalled decode
+    assert not drains, f"admission drained the pipeline: {drains}"

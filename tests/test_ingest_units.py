@@ -163,7 +163,9 @@ def test_enrich_nodes_batched_and_isolated():
     assert all(n.metadata["summary"] == "Does a thing." for n in nodes)
     assert all(n.metadata["title"] == "Thing Doer" for n in nodes)
     assert all(n.metadata["keywords"].startswith("alpha") for n in nodes)
-    assert all(n.metadata["topics"] == "alpha" for n in nodes)
+    # every keyword becomes a topic (shredded at write time for ANY-member filters)
+    assert all(n.metadata["topics"][0] == "alpha" for n in nodes)
+    assert all(len(n.metadata["topics"]) >= 2 for n in nodes)
 
 
 def test_enrich_survives_llm_explosion():
@@ -185,3 +187,91 @@ def test_stable_ids_are_deterministic():
     n3 = Node(text="same", metadata={"scope": "chunk", "repo": "r", "span": "3-4"})
     assert n1.stable_id() == n2.stable_id()
     assert n1.stable_id() != n3.stable_id()
+
+
+# ------------------------------------------------- chunker AST backends ----
+
+
+_PY_FIXTURE = '''\
+import os
+
+@decorator
+def first(a, b):
+    """doc"""
+    return a + b
+
+class Big:
+    x = 1
+
+    def method_one(self):
+        return 1
+
+    @property
+    def method_two(self):
+        return 2
+
+def last():
+    pass
+'''
+
+
+def test_pyast_and_regex_backends_agree_on_budgets():
+    from githubrepostorag_tpu.ingest.chunker import split_code
+
+    for backend in ("pyast", "regex"):
+        chunks = split_code(_PY_FIXTURE, "python", max_lines=8, max_chars=400,
+                            backend=backend)
+        assert chunks, backend
+        for c in chunks:
+            assert c.end_line - c.start_line + 1 <= 8, backend
+            assert len(c.text) <= 400, backend
+        # no content lost: every non-empty source line appears in some chunk
+        joined = "\n".join(c.text for c in chunks)
+        for line in _PY_FIXTURE.splitlines():
+            if line.strip():
+                assert line in joined, (backend, line)
+
+
+def test_pyast_backend_splits_at_true_ast_boundaries():
+    from githubrepostorag_tpu.ingest.chunker import _pyast_boundaries
+
+    lines = _PY_FIXTURE.splitlines()
+    bounds = _pyast_boundaries(_PY_FIXTURE, lines)
+    texts = [lines[b] for b in bounds]
+    assert "import os" in texts
+    assert "@decorator" in texts          # decorator glued to its def
+    assert "class Big:" in texts
+    assert "    def method_one(self):" in texts  # class methods sub-chunk
+    assert "    @property" in texts
+    assert "def last():" in texts
+
+
+def test_pyast_backend_degrades_on_syntax_errors():
+    from githubrepostorag_tpu.ingest.chunker import split_code
+
+    broken = "def f(:\n    print 'py2'\nmore text here\n" * 5
+    chunks = split_code(broken, "python", backend="pyast")
+    assert chunks  # regex fallback still chunks it
+    assert split_code(broken, "python", backend="auto")
+
+
+def test_treesitter_backend_when_available():
+    import pytest
+    pytest.importorskip("tree_sitter_language_pack")
+    from githubrepostorag_tpu.ingest.chunker import split_code
+
+    chunks = split_code(_PY_FIXTURE, "python", backend="treesitter")
+    assert chunks
+
+
+def test_treesitter_backend_raises_cleanly_when_missing():
+    import pytest
+    try:
+        import tree_sitter_language_pack  # noqa: F401
+        pytest.skip("tree-sitter installed; unavailability path not testable")
+    except ImportError:
+        pass
+    from githubrepostorag_tpu.ingest.chunker import split_code
+
+    with pytest.raises(RuntimeError, match="tree-sitter backend unavailable"):
+        split_code(_PY_FIXTURE, "python", backend="treesitter")

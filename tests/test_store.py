@@ -84,3 +84,54 @@ def test_health_reports_tables():
     h = s.health()
     assert h["status"] == "UP"
     assert h["tables"] == {"embeddings": 1}
+
+
+def test_shredded_topics_filter_matches_any_member():
+    """Reference parity: ShreddingTransformer explodes list metadata so a
+    topics=<member> equality filter matches (vector_write_service.py:118).
+    The round-1 flatten-to-string made such filters silently return zero."""
+    from githubrepostorag_tpu.ingest.vector_write import sanitize_metadata
+
+    store = MemoryVectorStore()
+    meta = sanitize_metadata(
+        {"scope": "chunk", "topics": ["Kafka", "Streams", "Consumer-Groups"],
+         "keywords": "Kafka, Streams", "file_path": "a.py"},
+        "chunk",
+    )
+    # shredded entries present alongside the display value
+    assert meta["topics"] == "Kafka, Streams, Consumer-Groups"
+    assert meta["topics:kafka"] == "1" and meta["topics:consumer-groups"] == "1"
+    assert meta["keywords:streams"] == "1"
+
+    vec = np.asarray([1.0, 0.0], dtype=np.float32)
+    store.upsert("embeddings", [Doc("d1", "kafka consumer", meta, vec)])
+    store.upsert("embeddings", [Doc("d2", "other", {"topics": "redis"}, vec)])
+
+    hits = store.search("embeddings", vec, k=10, filter={"topics": "kafka"})
+    assert [h.doc.doc_id for h in hits] == ["d1"]
+    # scalar topics docs still match exact-equality
+    hits = store.search("embeddings", vec, k=10, filter={"topics": "redis"})
+    assert [h.doc.doc_id for h in hits] == ["d2"]
+    assert [d.doc_id for d in store.find_by_metadata("embeddings", {"topics": "streams"})] == ["d1"]
+
+
+def test_tech_synonym_topics_filter_retrieves_end_to_end():
+    """The agent's TECH_SYNONYMS plan filter (agent/graph.py) must retrieve
+    extractor-enriched chunks whose topics LIST contains the tech."""
+    from githubrepostorag_tpu.embedding import HashingTextEncoder
+    from githubrepostorag_tpu.ingest.vector_write import sanitize_metadata
+    from githubrepostorag_tpu.retrieval.retrievers import ScopeRetriever
+
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    text = "consumer group rebalance handler"
+    meta = sanitize_metadata(
+        {"scope": "chunk", "namespace": "default", "repo": "svc",
+         "module": "stream", "file_path": "stream/consumer.py",
+         "topics": ["kafka", "rebalance", "consumer"]},
+        "chunk",
+    )
+    store.upsert("embeddings", [Doc("k1", text, meta, enc.encode([text])[0])])
+    r = ScopeRetriever(store, enc, "chunk")
+    docs = r.retrieve("how does the kafka consumer rebalance?",
+                      {"namespace": "default", "topics": "kafka"})
+    assert [d.doc_id for d in docs][:1] == ["k1"]
