@@ -35,7 +35,9 @@ def _build_llm():
             raise SystemExit("LLM_BACKEND=inprocess requires MODEL_WEIGHTS_PATH")
         import ml_dtypes
 
-        params, cfg = load_qwen2(s.model_weights_path, dtype=ml_dtypes.bfloat16)
+        params, cfg = load_qwen2(
+            s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights
+        )
         engine = Engine(
             params, cfg,
             max_num_seqs=s.max_num_seqs,

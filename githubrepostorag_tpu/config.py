@@ -88,6 +88,11 @@ class Settings:
     context_window: int = field(default_factory=lambda: _env_int("CONTEXT_WINDOW", 11712))
     llm_backend: str = field(default_factory=lambda: os.getenv("LLM_BACKEND", "inprocess"))  # inprocess|http|fake
     model_weights_path: str = field(default_factory=lambda: os.getenv("MODEL_WEIGHTS_PATH", ""))
+    # int8 weight-only quantization at load (fits 7B on one 16 GB chip; the
+    # AWQ-equivalent of the reference's vLLM deployment, values.yaml:67)
+    quantize_weights: bool = field(
+        default_factory=lambda: os.getenv("QUANTIZE_WEIGHTS", "").lower() in ("1", "int8", "true")
+    )
 
     # --- Worker ---
     default_namespace: str = field(default_factory=lambda: os.getenv("DEFAULT_NAMESPACE", "default"))

@@ -84,8 +84,16 @@ def params_from_state_dict(state_dict: dict, cfg: Qwen2Config, dtype=np.float32)
     return params
 
 
-def load_qwen2(checkpoint_dir: str, dtype=np.float32) -> tuple[dict, Qwen2Config]:
-    """Load config.json + *.safetensors from a local directory."""
+def load_qwen2(
+    checkpoint_dir: str, dtype=np.float32, quantize: bool = False
+) -> tuple[dict, Qwen2Config]:
+    """Load config.json + *.safetensors from a local directory.
+
+    ``quantize=True`` converts every linear projection to weight-only int8
+    (models/quant.py) host-side before device placement — the path that
+    fits Qwen2-7B on a single 16 GB chip (the AWQ-equivalent of the
+    reference's Qwen2.5-Coder-7B-Instruct-AWQ deployment, values.yaml:67).
+    """
     from safetensors import safe_open  # ships with transformers' deps
 
     root = Path(checkpoint_dir)
@@ -97,4 +105,9 @@ def load_qwen2(checkpoint_dir: str, dtype=np.float32) -> tuple[dict, Qwen2Config
         with safe_open(str(shard), framework="np") as f:
             for key in f.keys():
                 state[key] = f.get_tensor(key)
-    return params_from_state_dict(state, cfg, dtype=dtype), cfg
+    params = params_from_state_dict(state, cfg, dtype=dtype)
+    if quantize:
+        from githubrepostorag_tpu.models.quant import quantize_qwen2_params
+
+        params = quantize_qwen2_params(params)
+    return params, cfg

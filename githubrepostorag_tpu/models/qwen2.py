@@ -20,6 +20,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from githubrepostorag_tpu.models.quant import QuantizedLinear, qmatmul
 from githubrepostorag_tpu.ops.attention import dense_attention
 from githubrepostorag_tpu.ops.norms import rms_norm
 from githubrepostorag_tpu.ops.rope import apply_rope, rope_cos_sin
@@ -126,16 +127,16 @@ def _block(cfg: Qwen2Config, h, p, cos, sin, attend):
     nq, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     hn = rms_norm(h, p["ln1"], cfg.rms_norm_eps)
-    q = (hn @ p["wq"] + p["bq"]).reshape(b, s, nq, hd)
-    k = (hn @ p["wk"] + p["bk"]).reshape(b, s, nkv, hd)
-    v = (hn @ p["wv"] + p["bv"]).reshape(b, s, nkv, hd)
+    q = (qmatmul(hn, p["wq"]) + p["bq"]).reshape(b, s, nq, hd)
+    k = (qmatmul(hn, p["wk"]) + p["bk"]).reshape(b, s, nkv, hd)
+    v = (qmatmul(hn, p["wv"]) + p["bv"]).reshape(b, s, nkv, hd)
     q, k = apply_rope(q, k, cos, sin)
 
     attn, cache_info = attend(q, k, v)
-    h = h + attn.reshape(b, s, nq * hd) @ p["wo"]
+    h = h + qmatmul(attn.reshape(b, s, nq * hd), p["wo"])
 
     hn = rms_norm(h, p["ln2"], cfg.rms_norm_eps)
-    h = h + (jax.nn.silu(hn @ p["wg"]) * (hn @ p["wu"])) @ p["wd"]
+    h = h + qmatmul(jax.nn.silu(qmatmul(hn, p["wg"])) * qmatmul(hn, p["wu"]), p["wd"])
     return h, cache_info
 
 
@@ -258,6 +259,10 @@ def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
         return jnp.einsum(
             "bsd,vd->bsv", h, params["embed"], preferred_element_type=jnp.float32
         )
+    if isinstance(lm_head, QuantizedLinear):
+        # dequantized per use; the convert+scale fuses into the dot
+        wd = lm_head.q.astype(h.dtype) * lm_head.s.astype(h.dtype)[None, :]
+        return jnp.einsum("bsd,dv->bsv", h, wd, preferred_element_type=jnp.float32)
     return jnp.einsum(
         "bsd,dv->bsv", h, lm_head, preferred_element_type=jnp.float32
     )

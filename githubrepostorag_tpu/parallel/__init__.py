@@ -26,6 +26,7 @@ can slot in without re-plumbing callers; Qwen2-7B on a v5e-8 fits with TP
 alone (SURVEY.md §2.3), so no pipeline schedule is implemented yet.
 """
 
+from githubrepostorag_tpu.parallel.distributed import maybe_initialize_distributed
 from githubrepostorag_tpu.parallel.mesh import (
     AXIS_NAMES,
     MeshPlan,
@@ -42,6 +43,7 @@ from githubrepostorag_tpu.parallel.sharding import (
 
 __all__ = [
     "AXIS_NAMES",
+    "maybe_initialize_distributed",
     "MeshPlan",
     "make_mesh",
     "plan_for_devices",

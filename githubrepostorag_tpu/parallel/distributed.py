@@ -1,0 +1,66 @@
+"""Multi-host runtime initialization: jax.distributed over ICI/DCN.
+
+The reference's model-level comm story is NCCL-inside-vLLM, unused at its
+single-GPU scale (SURVEY.md §2.3 / §5.8).  The TPU-native equivalent is
+jax.distributed: every host process joins one runtime, after which
+``jax.devices()`` is the GLOBAL device list and the same
+``make_mesh``/``pjit`` code paths scale from one chip to a multi-host pod
+— collectives ride ICI within a slice and DCN across slices, routed by
+XLA, with zero NCCL/MPI in-tree.
+
+Env contract (standard jax.distributed variables, also set by GKE/TPU-VM
+launchers):
+  JAX_COORDINATOR_ADDRESS  host:port of process 0   (required to opt in)
+  JAX_NUM_PROCESSES        total host processes
+  JAX_PROCESS_ID           this process's index
+On TPU pods jax can infer all three from the TPU metadata server, so
+``maybe_initialize_distributed()`` also honors plain
+``JAX_DISTRIBUTED=auto``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_initialized = False
+
+
+def maybe_initialize_distributed() -> bool:
+    """Join the multi-host runtime when configured; no-op otherwise.
+
+    Returns True when this process is part of a multi-host runtime.  Safe
+    to call from every entry point (server, worker, ingest, trainer) —
+    initialization happens at most once per process.
+    """
+    global _initialized
+    if _initialized:
+        return True
+
+    import jax
+
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    auto = os.environ.get("JAX_DISTRIBUTED", "").lower() == "auto"
+    if not coordinator and not auto:
+        return False
+
+    kwargs: dict = {}
+    if coordinator:
+        kwargs["coordinator_address"] = coordinator
+        num = os.environ.get("JAX_NUM_PROCESSES")
+        pid = os.environ.get("JAX_PROCESS_ID")
+        if num is not None:
+            kwargs["num_processes"] = int(num)
+        if pid is not None:
+            kwargs["process_id"] = int(pid)
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    logger.info(
+        "jax.distributed up: process %d/%d, %d global devices (%d local)",
+        jax.process_index(), jax.process_count(),
+        jax.device_count(), jax.local_device_count(),
+    )
+    return True

@@ -34,8 +34,14 @@ def _axis(mesh: Mesh, name: str, dim: int) -> str | None:
     return name if size > 1 and dim % size == 0 else None
 
 
-def qwen2_param_specs(cfg: Qwen2Config, mesh: Mesh) -> dict:
-    """PartitionSpec pytree matching ``models.qwen2.init_params`` structure."""
+def qwen2_param_specs(cfg: Qwen2Config, mesh: Mesh, params: dict | None = None) -> dict:
+    """PartitionSpec pytree matching ``models.qwen2.init_params`` structure.
+
+    When ``params`` is given and carries int8 ``QuantizedLinear`` leaves
+    (models/quant.py), each projection's spec becomes a matching
+    QuantizedLinear of specs — ``q`` sharded like the weight, ``s`` (per
+    output channel) sharded like the weight's output axis — so TP serving
+    composes with weight-only quantization."""
     nq, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     inter, d, v = cfg.intermediate_size, cfg.hidden_size, cfg.vocab_size
 
@@ -67,6 +73,20 @@ def qwen2_param_specs(cfg: Qwen2Config, mesh: Mesh) -> dict:
     }
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, vocab_tp)
+
+    if params is not None:
+        from githubrepostorag_tpu.models.quant import QuantizedLinear
+
+        def adapt(spec: P) -> QuantizedLinear:
+            # q shards like the weight; s is per-output-channel -> shard
+            # like the weight's trailing axis (leading stacked axes kept)
+            return QuantizedLinear(q=spec, s=P(*spec[:-2], spec[-1]))
+
+        for name, leaf in params["layers"].items():
+            if isinstance(leaf, QuantizedLinear):
+                specs["layers"][name] = adapt(specs["layers"][name])
+        if isinstance(params.get("lm_head"), QuantizedLinear):
+            specs["lm_head"] = adapt(specs["lm_head"])
     return specs
 
 

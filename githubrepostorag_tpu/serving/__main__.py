@@ -30,13 +30,24 @@ async def serve(host: str, port: int) -> None:
     from githubrepostorag_tpu.serving.openai_api import OpenAIServer
     from githubrepostorag_tpu.serving.tokenizer import HFTokenizer
 
-    from githubrepostorag_tpu.parallel import MeshPlan, make_mesh, plan_for_devices
+    from githubrepostorag_tpu.parallel import (
+        MeshPlan,
+        make_mesh,
+        maybe_initialize_distributed,
+        plan_for_devices,
+    )
 
+    maybe_initialize_distributed()  # multi-host pod -> global device list
     s = get_settings()
     if not s.model_weights_path:
         raise SystemExit("model server requires MODEL_WEIGHTS_PATH (a local HF checkpoint dir)")
-    logger.info("loading weights from %s", s.model_weights_path)
-    params, cfg = load_qwen2(s.model_weights_path, dtype=ml_dtypes.bfloat16)
+    logger.info(
+        "loading weights from %s%s", s.model_weights_path,
+        " (int8 weight-only)" if s.quantize_weights else "",
+    )
+    params, cfg = load_qwen2(
+        s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights
+    )
 
     # TP-shard the decoder over the chip's ICI mesh (vLLM's
     # --tensor-parallel-size equivalent; reference runs TP=1 on one GPU —

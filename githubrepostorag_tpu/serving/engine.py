@@ -118,7 +118,7 @@ class Engine:
                     f"num_kv_heads={cfg.num_kv_heads}; use plan_for_devices("
                     "..., num_heads=..., num_kv_heads=..., role='serve')"
                 )
-            params = shard_params(params, mesh, qwen2_param_specs(cfg, mesh))
+            params = shard_params(params, mesh, qwen2_param_specs(cfg, mesh, params))
         self.params = params
         self.cfg = cfg
         self.max_num_seqs = max_num_seqs

@@ -1,0 +1,48 @@
+"""Sharded model checkpointing via orbax (SURVEY.md §5.4: the reference
+needs none — vLLM loads from the HF hub — but a TPU-native framework owns
+its weights: training state and quantized/sharded serving params persist as
+orbax checkpoints whose arrays round-trip WITH their shardings, so a
+restore on the same mesh places every shard on its home device without a
+gather).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def save_checkpoint(path: str, tree: Any, *, force: bool = True) -> None:
+    """Write a pytree (params / TrainState fields) to ``path``.  Sharded
+    arrays are written from every host cooperatively under
+    jax.distributed."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=force)
+    logger.info("checkpoint written: %s", path)
+
+
+def load_checkpoint(path: str, template: Any | None = None) -> Any:
+    """Restore a pytree.  ``template`` (an abstract or concrete tree of the
+    same structure, e.g. sharded-initialized params) restores each array
+    with the template's sharding/dtype — the multi-host path; without it
+    arrays arrive host-local."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if template is None:
+            return ckptr.restore(path)
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape") else x,
+            template,
+        )
+        return ckptr.restore(path, abstract)

@@ -1,0 +1,39 @@
+"""maybe_initialize_distributed: env contract + a real single-process
+jax.distributed runtime (subprocess so the test process's backend stays
+untouched)."""
+
+import os
+import subprocess
+import sys
+
+
+def test_noop_without_env(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_DISTRIBUTED", raising=False)
+    from githubrepostorag_tpu.parallel import maybe_initialize_distributed
+
+    assert maybe_initialize_distributed() is False
+
+
+def test_single_process_runtime_initializes():
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        JAX_COORDINATOR_ADDRESS="127.0.0.1:47013",
+        JAX_NUM_PROCESSES="1",
+        JAX_PROCESS_ID="0",
+    )
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');\n"
+        "from githubrepostorag_tpu.parallel import maybe_initialize_distributed\n"
+        "assert maybe_initialize_distributed() is True\n"
+        "assert maybe_initialize_distributed() is True  # idempotent\n"
+        "assert jax.process_count() == 1\n"
+        "print('DIST OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+        timeout=120, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DIST OK" in proc.stdout
