@@ -89,9 +89,11 @@ class Settings:
     llm_backend: str = field(default_factory=lambda: os.getenv("LLM_BACKEND", "inprocess"))  # inprocess|http|fake
     model_weights_path: str = field(default_factory=lambda: os.getenv("MODEL_WEIGHTS_PATH", ""))
     # int8 weight-only quantization at load (fits 7B on one 16 GB chip; the
-    # AWQ-equivalent of the reference's vLLM deployment, values.yaml:67)
+    # AWQ-equivalent of the reference's vLLM deployment, values.yaml:67).
+    # QUANTIZE_WEIGHTS=int8 also accepted alongside the usual booleans.
     quantize_weights: bool = field(
-        default_factory=lambda: os.getenv("QUANTIZE_WEIGHTS", "").lower() in ("1", "int8", "true")
+        default_factory=lambda: _env_bool("QUANTIZE_WEIGHTS", False)
+        or os.getenv("QUANTIZE_WEIGHTS", "").strip().lower() == "int8"
     )
 
     # --- Worker ---

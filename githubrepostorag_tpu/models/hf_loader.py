@@ -89,8 +89,9 @@ def load_qwen2(
 ) -> tuple[dict, Qwen2Config]:
     """Load config.json + *.safetensors from a local directory.
 
-    ``quantize=True`` converts every linear projection to weight-only int8
-    (models/quant.py) host-side before device placement — the path that
+    ``quantize=True`` converts every linear projection AND the embedding
+    table to weight-only int8 (models/quant.py) host-side before device
+    placement — the path that
     fits Qwen2-7B on a single 16 GB chip (the AWQ-equivalent of the
     reference's Qwen2.5-Coder-7B-Instruct-AWQ deployment, values.yaml:67).
     """

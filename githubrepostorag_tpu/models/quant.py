@@ -15,10 +15,11 @@ convert+scale fuses into the dot's operand read on TPU (measured ~590 GB/s
 effective weight bandwidth for 7B decode, i.e. no materialized bf16 copy),
 so no hand-written dequant kernel is needed.
 
-Embedding/norm/bias vectors stay bf16: they are either tiny or used as
-gathers (the embedding table's logits matmul IS quantized via the separate
-``lm_head`` path when untied; the tied-embedding case keeps bf16 logits —
-a gather through int8 would quantize activations too).
+The embedding table quantizes too (per-ROW scales — ``quantize_embedding``):
+a tied-weight model reads it in full every decode step for logits, so at
+0.5B it is ~27 % of per-step weight traffic.  Token lookups go through
+``embedding_lookup`` (gather int8 rows, scale per row).  Norms and biases
+stay bf16.
 """
 
 from __future__ import annotations
@@ -75,10 +76,34 @@ def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
-def quantize_qwen2_params(params: dict) -> dict:
-    """Quantize every linear projection of a Qwen2 param tree in place
-    (layers wq/wk/wv/wo/wg/wu/wd and lm_head when present); embeddings,
-    norms, and biases stay bf16."""
+def quantize_embedding(w) -> QuantizedLinear:
+    """Per-ROW symmetric int8 for the embedding table [V, d]: each vocab row
+    is one channel, so the tied-weight logits contraction over d dequantizes
+    per output logit, and the token-lookup path is ``q[ids] * s[ids]``."""
+    import ml_dtypes
+    import numpy as np
+
+    w_np = np.asarray(w, dtype=np.float32)
+    amax = np.max(np.abs(w_np), axis=-1, keepdims=True)  # [V, 1]
+    scale = np.maximum(amax / 127.0, 1e-8)
+    q = np.clip(np.round(w_np / scale), -127, 127).astype(np.int8)
+    s = np.squeeze(scale, axis=-1).astype(ml_dtypes.bfloat16)  # [V]
+    return QuantizedLinear(q=jnp.asarray(q), s=jnp.asarray(s))
+
+
+def embedding_lookup(embed, ids: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Token embedding gather for plain or int8 tables."""
+    if isinstance(embed, QuantizedLinear):
+        rows = jnp.take(embed.q, ids, axis=0).astype(dtype)
+        return rows * jnp.take(embed.s, ids, axis=0)[..., None].astype(dtype)
+    return jnp.take(embed, ids, axis=0)
+
+
+def quantize_qwen2_params(params: dict, embeddings: bool = True) -> dict:
+    """Quantize every linear projection of a Qwen2 param tree (layers
+    wq/wk/wv/wo/wg/wu/wd, lm_head when present, and — by default — the
+    embedding table, which a tied-weight model reads IN FULL every decode
+    step for logits); norms and biases stay bf16."""
     out = dict(params)
     layers = dict(params["layers"])
     for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
@@ -86,6 +111,8 @@ def quantize_qwen2_params(params: dict) -> dict:
     out["layers"] = layers
     if "lm_head" in params:
         out["lm_head"] = quantize_weight(params["lm_head"])
+    if embeddings:
+        out["embed"] = quantize_embedding(params["embed"])
     return out
 
 
@@ -128,7 +155,9 @@ def init_params_quantized(cfg, seed: int = 0) -> dict:
         "wu": qlin(L, d, inter),
         "wd": qlin(L, inter, d),
     }
-    params = {"embed": bf16(v, d), "layers": layers,
+    embed_q = jnp.asarray(rng.integers(-127, 128, (v, d), dtype=np.int8))
+    embed_s = jnp.full((v,), 0.02 / 73.0, dtype=jnp.bfloat16)
+    params = {"embed": QuantizedLinear(q=embed_q, s=embed_s), "layers": layers,
               "norm": jnp.ones((d,), dtype=jnp.bfloat16)}
     if not cfg.tie_word_embeddings:
         params["lm_head"] = qlin(d, v)

@@ -20,7 +20,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from githubrepostorag_tpu.models.quant import QuantizedLinear, qmatmul
+from githubrepostorag_tpu.models.quant import QuantizedLinear, embedding_lookup, qmatmul
 from githubrepostorag_tpu.ops.attention import dense_attention
 from githubrepostorag_tpu.ops.norms import rms_norm
 from githubrepostorag_tpu.ops.rope import apply_rope, rope_cos_sin
@@ -162,7 +162,7 @@ def forward(
     silently corrupt the newest cache entries — the serving engine
     (serving/engine.py) enforces the bound before dispatch.
     """
-    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = embedding_lookup(params["embed"], input_ids, dtype=_embed_dtype(params))
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     s = input_ids.shape[1]
 
@@ -234,7 +234,7 @@ def forward_with_attend(
     if attend_fn is None:
         attend_fn = lambda q, k, v: dense_attention(q, k, v, causal=True, q_offset=0)
 
-    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = embedding_lookup(params["embed"], input_ids, dtype=_embed_dtype(params))
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
 
     def body(h, layer_xs):
@@ -249,6 +249,13 @@ def forward_with_attend(
     return _logits(params, h)
 
 
+def _embed_dtype(params: dict):
+    """Activation dtype for the param tree — taken from the final norm
+    vector, which is always a plain array (embed may be int8, and its bf16
+    scales must not force bf16 activations on an f32 test tree)."""
+    return params["norm"].dtype
+
+
 def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
     """Final projection -> float32 logits (tied embedding or separate
     lm_head).  Operands stay in their stored dtype (bf16 on the MXU) with
@@ -256,8 +263,17 @@ def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
     would materialize a second full-vocab matrix every decode step."""
     lm_head = params.get("lm_head")
     if lm_head is None:
+        embed = params["embed"]
+        if isinstance(embed, QuantizedLinear):
+            # int8 tied embedding: dequant fuses into the contraction; the
+            # per-row scales apply to the OUTPUT logits
+            logits = jnp.einsum(
+                "bsd,vd->bsv", h, embed.q.astype(h.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return logits * embed.s.astype(jnp.float32)[None, None, :]
         return jnp.einsum(
-            "bsd,vd->bsv", h, params["embed"], preferred_element_type=jnp.float32
+            "bsd,vd->bsv", h, embed, preferred_element_type=jnp.float32
         )
     if isinstance(lm_head, QuantizedLinear):
         # dequantized per use; the convert+scale fuses into the dot
@@ -338,7 +354,7 @@ def forward_paged_impl(
     num_pages, page_size = k_pages.shape[2], k_pages.shape[3]
     total_slots = num_pages * page_size
 
-    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = embedding_lookup(params["embed"], input_ids, dtype=_embed_dtype(params))
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     # Padding slots arrive as -1; JAX scatter *wraps* negative indices (it
     # only drops indices >= size), so map them to an out-of-range positive

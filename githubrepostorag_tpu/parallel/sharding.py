@@ -87,6 +87,11 @@ def qwen2_param_specs(cfg: Qwen2Config, mesh: Mesh, params: dict | None = None) 
                 specs["layers"][name] = adapt(specs["layers"][name])
         if isinstance(params.get("lm_head"), QuantizedLinear):
             specs["lm_head"] = adapt(specs["lm_head"])
+        if isinstance(params["embed"], QuantizedLinear):
+            # embed scales are per vocab ROW: shard like the leading axis
+            specs["embed"] = QuantizedLinear(
+                q=specs["embed"], s=P(specs["embed"][0])
+            )
     return specs
 
 

@@ -42,7 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from githubrepostorag_tpu.models.qwen2 import Qwen2Config, _block, _logits
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, _block, _embed_dtype, _logits
+from githubrepostorag_tpu.models.quant import embedding_lookup
 from githubrepostorag_tpu.ops.attention import dense_attention
 from githubrepostorag_tpu.ops.paged_attention import gather_kv
 from githubrepostorag_tpu.ops.pallas_paged import paged_attention_decode_staged
@@ -136,7 +137,9 @@ def decode_burst(
 
         # last may carry the -1 inactive sentinel (packed tokens chained
         # across bursts); clamp so inactive rows look up a real embedding
-        h = jnp.take(params["embed"], jnp.maximum(last, 0)[:, None], axis=0)  # [B, 1, d]
+        h = embedding_lookup(
+            params["embed"], jnp.maximum(last, 0)[:, None], dtype=_embed_dtype(params)
+        )  # [B, 1, d]
         cos, sin = rope_cos_sin(lens[:, None], hd, cfg.rope_theta)
 
         def attend_for(kp, vp, sk, sv):

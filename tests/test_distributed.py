@@ -16,10 +16,15 @@ def test_noop_without_env(monkeypatch):
 
 
 def test_single_process_runtime_initializes():
+    import socket
+
+    with socket.socket() as probe:  # grab a free port to avoid collisions
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
-        JAX_COORDINATOR_ADDRESS="127.0.0.1:47013",
+        JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
         JAX_NUM_PROCESSES="1",
         JAX_PROCESS_ID="0",
     )
