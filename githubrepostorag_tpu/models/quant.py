@@ -31,16 +31,26 @@ import jax.numpy as jnp
 
 
 class QuantizedLinear(NamedTuple):
-    """Weight-only int8 tensor: ``q`` int8 [in, out], ``s`` bf16 [out]."""
+    """Weight-only int8 projection: ``q`` int8 [.., in, out], ``s`` bf16
+    per OUTPUT channel [.., out]."""
 
     q: jnp.ndarray
     s: jnp.ndarray
 
 
-def quantize_weight(w) -> QuantizedLinear:
-    """Per-output-channel symmetric int8.  ``w`` is [in, out] or stacked
-    [L, in, out]; the input (reduction) axis is -2, so scales are [out] /
-    [L, out].
+class QuantizedEmbedding(NamedTuple):
+    """Weight-only int8 embedding table: ``q`` int8 [V, d], ``s`` bf16 per
+    vocab ROW [V].  A distinct type from QuantizedLinear because the scale
+    axis differs — generic linear consumers (qmatmul/dequantize) must not
+    silently apply row scales as column scales."""
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+
+
+def _quantize_symmetric(w, axis: int):
+    """Shared symmetric-int8 recipe: reduce |w| over ``axis``, scale to
+    127, round/clip, bf16 scales with the reduced axis squeezed out.
 
     Computed HOST-side in numpy: quantizing a 7B tree with eager device ops
     would transiently materialize ~15 GB of f32 on the 16 GB chip this
@@ -50,11 +60,19 @@ def quantize_weight(w) -> QuantizedLinear:
     import numpy as np
 
     w_np = np.asarray(w, dtype=np.float32)  # pulls device arrays to host
-    amax = np.max(np.abs(w_np), axis=-2, keepdims=True)
-    scale = np.maximum(amax / 127.0, 1e-8)  # [.., 1, out]
+    amax = np.max(np.abs(w_np), axis=axis, keepdims=True)
+    scale = np.maximum(amax / 127.0, 1e-8)
     q = np.clip(np.round(w_np / scale), -127, 127).astype(np.int8)
-    s = np.squeeze(scale, axis=-2).astype(ml_dtypes.bfloat16)
-    return QuantizedLinear(q=jnp.asarray(q), s=jnp.asarray(s))
+    s = np.squeeze(scale, axis=axis).astype(ml_dtypes.bfloat16)
+    return jnp.asarray(q), jnp.asarray(s)
+
+
+def quantize_weight(w) -> QuantizedLinear:
+    """Per-output-channel symmetric int8.  ``w`` is [in, out] or stacked
+    [L, in, out]; the input (reduction) axis is -2, so scales are [out] /
+    [L, out]."""
+    q, s = _quantize_symmetric(w, axis=-2)
+    return QuantizedLinear(q=q, s=s)
 
 
 def dequantize(t: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
@@ -76,24 +94,17 @@ def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
-def quantize_embedding(w) -> QuantizedLinear:
+def quantize_embedding(w) -> QuantizedEmbedding:
     """Per-ROW symmetric int8 for the embedding table [V, d]: each vocab row
     is one channel, so the tied-weight logits contraction over d dequantizes
     per output logit, and the token-lookup path is ``q[ids] * s[ids]``."""
-    import ml_dtypes
-    import numpy as np
-
-    w_np = np.asarray(w, dtype=np.float32)
-    amax = np.max(np.abs(w_np), axis=-1, keepdims=True)  # [V, 1]
-    scale = np.maximum(amax / 127.0, 1e-8)
-    q = np.clip(np.round(w_np / scale), -127, 127).astype(np.int8)
-    s = np.squeeze(scale, axis=-1).astype(ml_dtypes.bfloat16)  # [V]
-    return QuantizedLinear(q=jnp.asarray(q), s=jnp.asarray(s))
+    q, s = _quantize_symmetric(w, axis=-1)
+    return QuantizedEmbedding(q=q, s=s)
 
 
 def embedding_lookup(embed, ids: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
     """Token embedding gather for plain or int8 tables."""
-    if isinstance(embed, QuantizedLinear):
+    if isinstance(embed, QuantizedEmbedding):
         rows = jnp.take(embed.q, ids, axis=0).astype(dtype)
         return rows * jnp.take(embed.s, ids, axis=0)[..., None].astype(dtype)
     return jnp.take(embed, ids, axis=0)
@@ -157,7 +168,7 @@ def init_params_quantized(cfg, seed: int = 0) -> dict:
     }
     embed_q = jnp.asarray(rng.integers(-127, 128, (v, d), dtype=np.int8))
     embed_s = jnp.full((v,), 0.02 / 73.0, dtype=jnp.bfloat16)
-    params = {"embed": QuantizedLinear(q=embed_q, s=embed_s), "layers": layers,
+    params = {"embed": QuantizedEmbedding(q=embed_q, s=embed_s), "layers": layers,
               "norm": jnp.ones((d,), dtype=jnp.bfloat16)}
     if not cfg.tie_word_embeddings:
         params["lm_head"] = qlin(d, v)

@@ -20,7 +20,12 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from githubrepostorag_tpu.models.quant import QuantizedLinear, embedding_lookup, qmatmul
+from githubrepostorag_tpu.models.quant import (
+    QuantizedEmbedding,
+    QuantizedLinear,
+    embedding_lookup,
+    qmatmul,
+)
 from githubrepostorag_tpu.ops.attention import dense_attention
 from githubrepostorag_tpu.ops.norms import rms_norm
 from githubrepostorag_tpu.ops.rope import apply_rope, rope_cos_sin
@@ -264,7 +269,7 @@ def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
     lm_head = params.get("lm_head")
     if lm_head is None:
         embed = params["embed"]
-        if isinstance(embed, QuantizedLinear):
+        if isinstance(embed, QuantizedEmbedding):
             # int8 tied embedding: dequant fuses into the contraction; the
             # per-row scales apply to the OUTPUT logits
             logits = jnp.einsum(

@@ -87,9 +87,11 @@ def qwen2_param_specs(cfg: Qwen2Config, mesh: Mesh, params: dict | None = None) 
                 specs["layers"][name] = adapt(specs["layers"][name])
         if isinstance(params.get("lm_head"), QuantizedLinear):
             specs["lm_head"] = adapt(specs["lm_head"])
-        if isinstance(params["embed"], QuantizedLinear):
+        from githubrepostorag_tpu.models.quant import QuantizedEmbedding
+
+        if isinstance(params["embed"], QuantizedEmbedding):
             # embed scales are per vocab ROW: shard like the leading axis
-            specs["embed"] = QuantizedLinear(
+            specs["embed"] = QuantizedEmbedding(
                 q=specs["embed"], s=P(specs["embed"][0])
             )
     return specs

@@ -304,3 +304,33 @@ def test_mid_decode_admission_keeps_pipeline(tiny):
     # the admission itself must not have drained a live pipeline: a drain
     # while a request sat in the waiting queue means admission stalled decode
     assert not drains, f"admission drained the pipeline: {drains}"
+
+
+def test_cancelled_pending_first_wave_does_not_corrupt_others(tiny):
+    """Regression: a request cancelled after its prefill wave was queued but
+    before the next decode dispatch has row == -1; the overlay must skip it
+    (a negative scatter index would WRAP to the last row and corrupt an
+    unrelated stream's last-token state)."""
+    _, params, cfg = tiny
+    sp = SamplingParams(max_tokens=12, temperature=0.0, stop_token_ids=())
+    solo = Engine(params, cfg, max_num_seqs=4, num_pages=64, page_size=4,
+                  max_seq_len=64, decode_burst=4).generate([[1, 2, 3, 4]], sp)[0]
+
+    eng = Engine(params, cfg, max_num_seqs=4, num_pages=64, page_size=4,
+                 max_seq_len=64, decode_burst=4)
+    r1 = eng.add_request([1, 2, 3, 4], sp)
+    for _ in range(3):  # r1 mid-decode with a live chain
+        eng.step()
+    assert eng._chain is not None
+    r2 = eng.add_request([9, 8, 7], sp)
+    eng.step()  # prefill wave for r2 -> _pending_first (no drain)
+    assert eng._pending_first
+    eng.cancel(r2)
+
+    done = {}
+    while eng.has_work():
+        for res in eng.step():
+            done[res.request_id] = res
+    assert done[r2].finish_reason == "cancelled"
+    # the victim stream must be byte-identical to its solo run
+    assert done[r1].output_tokens == solo.output_tokens
