@@ -54,7 +54,7 @@ def _prompts(n: int, length: int, vocab: int, seed: int = 0) -> list[list[int]]:
 
 def bench_decode(cfg, tag: str, *, batch: int, prompt_len: int, gen_tokens: int,
                  num_pages: int, page_size: int, max_seq: int, runs: int = 3,
-                 params=None):
+                 params=None, decode_burst: int = 64):
     """Continuous-batching decode throughput (eval configs #1/#2 geometry).
     Returns (median tok/s, median ttft, params) so callers can reuse the
     initialized weights."""
@@ -76,7 +76,7 @@ def bench_decode(cfg, tag: str, *, batch: int, prompt_len: int, gen_tokens: int,
         return Engine(params, cfg, max_num_seqs=batch, num_pages=num_pages,
                       page_size=page_size, max_seq_len=max_seq,
                       prefill_chunk=prompt_len, use_pallas=pallas,
-                      decode_burst=32)
+                      decode_burst=decode_burst)
 
     def run(pallas: bool):
         eng = build(pallas)
@@ -184,9 +184,11 @@ def bench_7b_int8() -> float:
     jax.block_until_ready(params)
     log(f"bench[qwen2-7b-int8]: {params_nbytes(params) / 1e9:.2f} GB on chip; "
         "compiling (~13 min)")
+    # burst 32 (not 64): the 7B burst program's XLA compile time scales
+    # with n_steps and already dominates this bench item
     tps, _, _ = bench_decode(cfg, "qwen2-7b-int8", batch=8, prompt_len=128,
                              gen_tokens=128, num_pages=40, page_size=256,
-                             max_seq=1024, params=params)
+                             max_seq=1024, params=params, decode_burst=32)
     return tps
 
 
@@ -252,7 +254,7 @@ def _main() -> None:
         cfg = Qwen2Config.tiny()
         tps, _, _ = bench_decode(cfg, "tiny-cpu", batch=4, prompt_len=32,
                                  gen_tokens=16, num_pages=128, page_size=16,
-                                 max_seq=256, runs=1)
+                                 max_seq=256, runs=1, decode_burst=16)
         emit("decode_tok_s_tiny_cpu", tps, "tok/s", tps / BASELINE_TOK_S)
 
 
