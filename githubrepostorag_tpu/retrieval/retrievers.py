@@ -41,6 +41,12 @@ class ScopeSpec:
     adjacent_k: int
     max_depth: int
     edges: tuple[str, ...]  # metadata keys joined on equality
+    # MMR diversity re-ranking: final selection maximizes
+    # lambda*relevance - (1-lambda)*max_similarity_to_selected.  None = pure
+    # relevance (the reference's live Eager strategy); the narrow scopes use
+    # the lambdas its richer GraphRetrieverFactory design specified
+    # (GraphRetrieverFactory.py:105-161 — dead code there, live here).
+    mmr_lambda: float | None = None
 
 
 # Fan-out parameters mirror graph_rag_retrievers.py:104-134; edge sets follow
@@ -50,10 +56,45 @@ class ScopeSpec:
 SCOPE_SPECS: dict[str, ScopeSpec] = {
     "catalog": ScopeSpec("catalog", k=4, start_k=2, adjacent_k=4, max_depth=1, edges=("namespace",)),
     "repo": ScopeSpec("repo", k=6, start_k=2, adjacent_k=6, max_depth=2, edges=("namespace",)),
-    "module": ScopeSpec("module", k=8, start_k=3, adjacent_k=8, max_depth=2, edges=("repo",)),
-    "file": ScopeSpec("file", k=10, start_k=3, adjacent_k=8, max_depth=2, edges=("module", "repo")),
-    "chunk": ScopeSpec("chunk", k=10, start_k=3, adjacent_k=8, max_depth=2, edges=("file_path", "module")),
+    "module": ScopeSpec("module", k=8, start_k=3, adjacent_k=8, max_depth=2, edges=("repo",),
+                        mmr_lambda=0.4),
+    "file": ScopeSpec("file", k=10, start_k=3, adjacent_k=8, max_depth=2, edges=("module", "repo"),
+                      mmr_lambda=0.4),
+    "chunk": ScopeSpec("chunk", k=10, start_k=3, adjacent_k=8, max_depth=2, edges=("file_path", "module"),
+                       mmr_lambda=0.3),
 }
+
+
+def mmr_select(
+    docs: Sequence[RetrievedDoc],
+    vectors: Mapping[str, np.ndarray],
+    k: int,
+    lam: float,
+) -> list[RetrievedDoc]:
+    """Maximal-marginal-relevance selection: greedily pick the doc
+    maximizing ``lam*relevance - (1-lam)*max_cos_to_already_selected``.
+    Docs without vectors fall back to relevance-only (penalty 0)."""
+    remaining = sorted(docs, key=lambda d: d.score, reverse=True)
+    selected: list[RetrievedDoc] = []
+    # running max-similarity-to-selected per candidate: only the vector
+    # added last round can raise it, so each round is one dot per candidate
+    penalty = {d.doc_id: 0.0 for d in remaining}
+    last_vec: np.ndarray | None = None
+    while remaining and len(selected) < k:
+        if last_vec is not None:
+            for d in remaining:
+                v = vectors.get(d.doc_id)
+                if v is not None:
+                    penalty[d.doc_id] = max(penalty[d.doc_id], float(v @ last_vec))
+        best_i = max(
+            range(len(remaining)),
+            key=lambda i: lam * remaining[i].score
+            - (1.0 - lam) * penalty[remaining[i].doc_id],
+        )
+        pick = remaining.pop(best_i)
+        selected.append(pick)
+        last_vec = vectors.get(pick.doc_id)
+    return selected
 
 # The canonical five-level ladder, broadest to narrowest.  The agent's
 # stage-down routing and prompt vocabulary import THIS — one source of truth.
@@ -82,10 +123,21 @@ class ScopeRetriever:
 
         seeds = self.store.search(self.table, qvec, spec.start_k, filter=flt)
         found: dict[str, RetrievedDoc] = {}
+        vectors: dict[str, np.ndarray] = {}  # unit vectors, for MMR
+
+        def remember_vector(doc_id: str, vec) -> None:
+            if vec is None:
+                return
+            v = np.asarray(vec, dtype=np.float32)
+            n = np.linalg.norm(v)
+            if n > 0:
+                vectors[doc_id] = v / n
+
         for hit in seeds:
             found[hit.doc.doc_id] = RetrievedDoc(
                 hit.doc.doc_id, hit.doc.text, dict(hit.doc.metadata), hit.score, depth=0
             )
+            remember_vector(hit.doc.doc_id, hit.doc.vector)
 
         qnorm = np.linalg.norm(qvec)
         frontier = list(found.values())
@@ -111,11 +163,14 @@ class ScopeRetriever:
                                 score = float(v @ qvec / (vn * qnorm))
                         rd = RetrievedDoc(adj.doc_id, adj.text, dict(adj.metadata), score, depth=depth)
                         found[adj.doc_id] = rd
+                        remember_vector(adj.doc_id, adj.vector)
                         next_frontier.append(rd)
             frontier = next_frontier
             if not frontier:
                 break
 
+        if spec.mmr_lambda is not None:
+            return mmr_select(list(found.values()), vectors, spec.k, spec.mmr_lambda)
         ranked = sorted(found.values(), key=lambda d: d.score, reverse=True)
         return ranked[: spec.k]
 

@@ -46,6 +46,16 @@ _DDL_VIDX = (
     " USING 'org.apache.cassandra.index.sai.StorageAttachedIndex'"
     " WITH OPTIONS = {{'similarity_function':'cosine'}}"
 )
+def _row_doc(r) -> "Doc":
+    """Row -> Doc including the stored vector (traversal scoring and MMR
+    re-ranking need it; omitting the column silently degrades both)."""
+    vec = getattr(r, "vector", None)
+    return Doc(
+        r.row_id, r.body_blob or "", dict(r.metadata_s or {}),
+        np.asarray(vec, dtype=np.float32) if vec is not None else None,
+    )
+
+
 _DDL_MIDX = (
     "CREATE CUSTOM INDEX IF NOT EXISTS eidx_metadata_s_{table} ON {ks}.{table}"
     " (entries(metadata_s))"
@@ -129,14 +139,15 @@ class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only
                 where = " WHERE " + " AND ".join(clauses)
             params.append(int(k))
             cql = (
-                f"SELECT row_id, body_blob, metadata_s, similarity_cosine(vector, %s) AS score "
+                f"SELECT row_id, body_blob, metadata_s, vector, "
+                f"similarity_cosine(vector, %s) AS score "
                 f"FROM {self._ks}.{table}{where} ORDER BY vector ANN OF %s LIMIT %s"
             )
             # ANN OF needs the vector twice (score projection + ordering)
             params.insert(-1, vec)
             rows = self._session.execute(cql, params)
             hits = [
-                SearchHit(Doc(r.row_id, r.body_blob or "", dict(r.metadata_s or {})), float(r.score))
+                SearchHit(_row_doc(r), float(r.score))
                 for r in rows
             ]
             if hits:
@@ -152,11 +163,11 @@ class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only
                 params.extend([key, val])
             params.append(int(limit))
             cql = (
-                f"SELECT row_id, body_blob, metadata_s FROM {self._ks}.{table} "
+                f"SELECT row_id, body_blob, metadata_s, vector FROM {self._ks}.{table} "
                 f"WHERE {' AND '.join(clauses)} LIMIT %s"
             )
             rows = self._session.execute(cql, params)
-            docs = [Doc(r.row_id, r.body_blob or "", dict(r.metadata_s or {})) for r in rows]
+            docs = [_row_doc(r) for r in rows]
             if docs:
                 return docs
         return []
@@ -164,11 +175,12 @@ class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only
     def get(self, table: str, doc_id: str) -> Doc | None:
         self._ensure_table(table)
         rows = self._session.execute(
-            f"SELECT row_id, body_blob, metadata_s FROM {self._ks}.{table} WHERE row_id = %s",
+            f"SELECT row_id, body_blob, metadata_s, vector FROM {self._ks}.{table} "
+            f"WHERE row_id = %s",
             (doc_id,),
         )
         row = rows.one()
-        return Doc(row.row_id, row.body_blob or "", dict(row.metadata_s or {})) if row else None
+        return _row_doc(row) if row else None
 
     def count(self, table: str) -> int:
         self._ensure_table(table)

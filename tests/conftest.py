@@ -11,18 +11,22 @@ import sys
 # backends, and because this machine's sitecustomize imports jax at
 # interpreter startup (pinning JAX_PLATFORMS=axon -> the TPU), we must ALSO
 # override via jax.config after import.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+_TPU_TESTS = os.environ.get("TPU_TESTS") == "1"  # integration runs on the chip
+
+if not _TPU_TESTS:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
-assert jax.device_count() == 8, "tests expect the virtual 8-device CPU mesh"
+if not _TPU_TESTS:
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", "tests must run on the CPU backend"
+    assert jax.device_count() == 8, "tests expect the virtual 8-device CPU mesh"
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -168,7 +168,7 @@ def test_find_by_metadata_cql_and_fallback():
     s.script("WHERE metadata_s", [row])
     docs = store.find_by_metadata("embeddings", {"module": "api"}, limit=7)
     cql, params = executed(s, "WHERE metadata_s")[0]
-    assert cql.startswith("SELECT row_id, body_blob, metadata_s FROM vector_store.embeddings")
+    assert cql.startswith("SELECT row_id, body_blob, metadata_s, vector FROM vector_store.embeddings")
     assert params == ["module", "api", 7]
     assert [d.doc_id for d in docs] == ["r2"]
 

@@ -76,3 +76,37 @@ def test_factory_caches_and_validates():
 def test_empty_store_returns_empty():
     f = RetrieverFactory(MemoryVectorStore(), HashingTextEncoder())
     assert f.retrieve("chunk", "anything") == []
+
+
+def test_mmr_prefers_diverse_over_redundant():
+    """MMR selection (the reference's richer GraphRetrieverFactory design,
+    dead there, live here): given near-duplicate top hits, the second pick
+    must be the diverse document, not the duplicate."""
+    from githubrepostorag_tpu.retrieval.retrievers import RetrievedDoc, mmr_select
+
+    a = np.asarray([1.0, 0.0], dtype=np.float32)
+    a_dup = np.asarray([0.999, 0.045], dtype=np.float32)
+    a_dup /= np.linalg.norm(a_dup)
+    b = np.asarray([0.0, 1.0], dtype=np.float32)
+    docs = [
+        RetrievedDoc("a", "", {}, 0.95),
+        RetrievedDoc("a_dup", "", {}, 0.94),
+        RetrievedDoc("b", "", {}, 0.60),
+    ]
+    vectors = {"a": a, "a_dup": a_dup, "b": b}
+    picked = [d.doc_id for d in mmr_select(docs, vectors, k=2, lam=0.4)]
+    assert picked == ["a", "b"]
+    # pure relevance would have picked the duplicate
+    ranked = [d.doc_id for d in sorted(docs, key=lambda d: d.score, reverse=True)][:2]
+    assert ranked == ["a", "a_dup"]
+
+
+def test_mmr_scope_retriever_end_to_end():
+    from githubrepostorag_tpu.retrieval.retrievers import SCOPE_SPECS, ScopeRetriever
+
+    assert SCOPE_SPECS["chunk"].mmr_lambda == 0.3  # reference lambdas
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    _seed(store, enc)
+    r = ScopeRetriever(store, enc, "chunk")
+    docs = r.retrieve("how do I create a job?", {"namespace": "default"})
+    assert docs and docs[0].doc_id == "c1"  # top relevance still leads
