@@ -245,8 +245,12 @@ class Engine:
     # --------------------------------------------------------- scheduling --
 
     def step(self) -> list[GenerationResult]:
-        """One engine iteration: admit + prefill one chunk if possible, else
-        decode every running row.  Returns requests finished this step."""
+        """One engine iteration: admit + prefill one chunk AND decode every
+        running row — both dispatched in the same step with no host sync in
+        between (vLLM's chunked-prefill mixing).  The device serializes the
+        two programs on the donated pools, so a long multi-chunk prompt
+        never stalls running streams: each of its prefill steps rides along
+        with a full decode burst.  Returns requests finished this step."""
         finished: list[GenerationResult] = []
         for req in self._rejected:
             res = self._result(req, "error")
@@ -255,8 +259,8 @@ class Engine:
         self._rejected.clear()
         self._reap_cancelled(finished)
 
-        did_prefill = self._try_prefill(finished)
-        if not did_prefill and self._row_req:
+        self._try_prefill(finished)
+        if any(r.state == "running" for r in self._row_req.values()):
             self._decode_step(finished)
         if not self._row_req:
             # nothing left running: land any in-flight burst (its tokens
@@ -458,7 +462,9 @@ class Engine:
         remaining = 1
         for row, req in self._row_req.items():
             active[row] = req.state == "running"  # mid-prefill rows sit out
-            remaining = max(remaining, req.sampling.max_tokens - len(req.output))
+            if req.state == "running":  # mid-prefill budgets don't hold the
+                # drain shortcut open: they can't consume burst tokens yet
+                remaining = max(remaining, req.sampling.max_tokens - len(req.output))
         # ONE compiled burst shape: always decode_burst steps.  Overshoot
         # past a row's max_tokens is discarded at commit — with continuous
         # batching the "wasted" steps still serve every other running row,

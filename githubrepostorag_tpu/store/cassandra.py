@@ -46,6 +46,13 @@ _DDL_VIDX = (
     " USING 'org.apache.cassandra.index.sai.StorageAttachedIndex'"
     " WITH OPTIONS = {{'similarity_function':'cosine'}}"
 )
+_DDL_MIDX = (
+    "CREATE CUSTOM INDEX IF NOT EXISTS eidx_metadata_s_{table} ON {ks}.{table}"
+    " (entries(metadata_s))"
+    " USING 'org.apache.cassandra.index.sai.StorageAttachedIndex'"
+)
+
+
 def _row_doc(r) -> "Doc":
     """Row -> Doc including the stored vector (traversal scoring and MMR
     re-ranking need it; omitting the column silently degrades both)."""
@@ -54,13 +61,6 @@ def _row_doc(r) -> "Doc":
         r.row_id, r.body_blob or "", dict(r.metadata_s or {}),
         np.asarray(vec, dtype=np.float32) if vec is not None else None,
     )
-
-
-_DDL_MIDX = (
-    "CREATE CUSTOM INDEX IF NOT EXISTS eidx_metadata_s_{table} ON {ks}.{table}"
-    " (entries(metadata_s))"
-    " USING 'org.apache.cassandra.index.sai.StorageAttachedIndex'"
-)
 
 
 class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only

@@ -14,7 +14,7 @@ import pytest
 
 import jax
 
-pytestmark = pytest.mark.integration
+pytestmark = [pytest.mark.integration, pytest.mark.tpu]
 
 
 @pytest.mark.skipif(jax.default_backend() != "tpu", reason="needs a real TPU chip")

@@ -306,6 +306,47 @@ def test_mid_decode_admission_keeps_pipeline(tiny):
     assert not drains, f"admission drained the pipeline: {drains}"
 
 
+def test_prefill_co_dispatches_with_decode(tiny):
+    """A multi-chunk prompt admitted mid-decode must NOT stall running
+    streams: every step that prefills a chunk also dispatches a decode
+    burst, and all outputs stay token-identical to solo runs."""
+    _, params, cfg = tiny
+    sp = SamplingParams(max_tokens=24, temperature=0.0, stop_token_ids=())
+    long_prompt = list(range(1, 49))  # 48 tokens -> 6 chunks at chunk=8
+    solo = {}
+    for prompt in ([1, 2, 3, 4], long_prompt):
+        eng = Engine(params, cfg, max_num_seqs=4, num_pages=64, page_size=4,
+                     max_seq_len=128, prefill_chunk=8, decode_burst=4)
+        solo[tuple(prompt)] = eng.generate([prompt], sp)[0].output_tokens
+
+    eng = Engine(params, cfg, max_num_seqs=4, num_pages=64, page_size=4,
+                 max_seq_len=128, prefill_chunk=8, decode_burst=4)
+    r1 = eng.add_request([1, 2, 3, 4], sp)
+    for _ in range(3):
+        eng.step()
+    assert eng._chain is not None
+
+    r2 = eng.add_request(long_prompt, sp)
+    bursts_during_prefill = 0
+    done = {}
+    while eng.has_work():
+        chain_before = eng._chain
+        prefilling = any(r.state == "prefilling" for r in eng._row_req.values())
+        for res in eng.step():
+            done[res.request_id] = res
+        req2 = eng._requests.get(r2)
+        still_prefilling = req2 is not None and req2.state == "prefilling"
+        if prefilling and still_prefilling and eng._chain is not chain_before:
+            bursts_during_prefill += 1
+    assert done[r1].output_tokens == solo[(1, 2, 3, 4)]
+    assert done[r2].output_tokens == solo[tuple(long_prompt)]
+    # r2 takes 6 prefill chunks; r1 must have decoded new bursts meanwhile
+    assert bursts_during_prefill >= 3, (
+        f"only {bursts_during_prefill} decode bursts dispatched while the "
+        "long prompt prefilled — running streams stalled"
+    )
+
+
 def test_cancelled_pending_first_wave_does_not_corrupt_others(tiny):
     """Regression: a request cancelled after its prefill wave was queued but
     before the next decode dispatch has row == -1; the overlay must skip it
@@ -323,7 +364,10 @@ def test_cancelled_pending_first_wave_does_not_corrupt_others(tiny):
         eng.step()
     assert eng._chain is not None
     r2 = eng.add_request([9, 8, 7], sp)
-    eng.step()  # prefill wave for r2 -> _pending_first (no drain)
+    # drive the prefill half of a step by hand: a full step() would consume
+    # the wave into the co-dispatched decode burst, and this regression is
+    # about a cancel landing in the window between those two dispatches
+    eng._try_prefill([])
     assert eng._pending_first
     eng.cancel(r2)
 
