@@ -138,4 +138,7 @@ class AsyncEngine:
                 "waiting": self.engine.num_waiting,
                 "free_pages": self.engine._allocator.free_count,
                 "total_pages": self.engine._allocator.num_pages,
+                "prefix_cache_hit_tokens": getattr(
+                    self.engine._allocator, "hit_tokens", 0
+                ),
             }

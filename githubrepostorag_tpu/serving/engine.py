@@ -39,7 +39,9 @@ from githubrepostorag_tpu.ops.sampling import sample_tokens
 from githubrepostorag_tpu.serving.kv_cache import (
     OutOfPages,
     PageAllocator,
+    PrefixCachingAllocator,
     make_page_pools,
+    page_hashes,
     pages_needed,
     slot_mapping,
 )
@@ -74,6 +76,9 @@ class _Request:
     pages: list[int] = field(default_factory=list)
     seq_len: int = 0  # tokens currently in the KV cache
     prefill_pos: int = 0
+    page_hashes: list[bytes] = field(default_factory=list)  # full prompt pages
+    pages_registered: int = 0  # prefix-cache pages published so far
+    cached_tokens: int = 0  # prompt tokens served from the prefix cache
     output: list[int] = field(default_factory=list)
     cancelled: bool = False
     error: str | None = None
@@ -100,6 +105,7 @@ class Engine:
         rng_seed: int = 0,
         decode_burst: int = 8,
         mesh=None,  # jax.sharding.Mesh -> TP-shard params, KV pools, compute
+        prefix_caching: bool = True,  # vLLM automatic-prefix-caching analog
     ) -> None:
         self.mesh = mesh
         if mesh is not None:
@@ -141,7 +147,10 @@ class Engine:
             self._k_pages = jax.device_put(self._k_pages, kv_sharding)
             self._v_pages = jax.device_put(self._v_pages, kv_sharding)
             self._replicated = NamedSharding(mesh, PS())
-        self._allocator = PageAllocator(num_pages)
+        self.prefix_caching = prefix_caching
+        self._allocator = (
+            PrefixCachingAllocator(num_pages) if prefix_caching else PageAllocator(num_pages)
+        )
 
         # host-side batch state
         self._block_tables = np.zeros((max_num_seqs, self.max_pages_per_seq), dtype=np.int32)
@@ -278,22 +287,34 @@ class Engine:
                 self._release(req)
                 finished.append(self._result(req, "cancelled"))
 
-    def _admission_feasible(self) -> bool:
-        """True when the head-of-queue request could actually be admitted
-        (row + pages available, counting rows/pages that a chain drain would
-        recycle).  Draining the decode pipeline is expensive — don't do it
-        for an admission the allocator would refuse anyway."""
-        if not self._waiting:
-            return False
-        req = self._waiting[0]
+    def _head_need_hashes(self, req: _Request) -> tuple[int, list[bytes]]:
+        """Total page need for ``req`` and the chain hashes of the prefix
+        pages an admission would be allowed to share (capped so at least one
+        prompt token still runs through prefill)."""
         need = pages_needed(
             min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size
         )
+        hashes: list[bytes] = []
+        if self.prefix_caching:
+            if not req.page_hashes:
+                req.page_hashes = page_hashes(req.prompt, self.page_size)
+            shareable = min(len(req.page_hashes), (len(req.prompt) - 1) // self.page_size)
+            hashes = req.page_hashes[:shareable]
+        return need, hashes
+
+    def _admission_feasible(self) -> bool:
+        """True when the head-of-queue request could actually be admitted
+        (row + pages available, counting prefix-cache shares and rows/pages
+        that a chain drain would recycle).  Draining the decode pipeline is
+        expensive — don't do it for an admission the allocator would refuse
+        anyway."""
+        if not self._waiting:
+            return False
+        req = self._waiting[0]
+        need, hashes = self._head_need_hashes(req)
         rows_avail = bool(self._free_rows) or bool(self._deferred)
-        pages_after_drain = self._allocator.free_count + sum(
-            len(pages) for _, pages in self._deferred
-        )
-        return rows_avail and pages_after_drain >= need
+        extra = sum(len(pages) for _, pages in self._deferred)
+        return rows_avail and self._allocator.can_admit(hashes, need, extra_free=extra)
 
     def _try_prefill(self, finished: list[GenerationResult]) -> bool:
         """Admit every waiting request the pool can back, then run ONE
@@ -307,34 +328,51 @@ class Engine:
         _admission_feasible)."""
         if self._waiting:
             req0 = self._waiting[0]
-            need0 = pages_needed(
-                min(len(req0.prompt) + req0.sampling.max_tokens, self.max_seq_len),
-                self.page_size,
-            )
-            can_free = bool(self._free_rows) and self._allocator.free_count >= need0
+            need0, hashes0 = self._head_need_hashes(req0)
+            can_free = bool(self._free_rows) and self._allocator.can_admit(hashes0, need0)
             if not can_free and self._admission_feasible():
                 self._drain_chain(finished)
         # admit as many waiting requests as rows + pages allow
         while self._waiting and self._free_rows:
             req = self._waiting[0]
-            need = pages_needed(
-                min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size
-            )
+            need, hashes = self._head_need_hashes(req)
             assert need <= self.max_pages_per_seq, "intake clamp must bound the page need"
+            shared = self._allocator.share(hashes) if hashes else []
             try:
-                pages = self._allocator.allocate(need)
+                pages = shared + self._allocator.allocate(need - len(shared))
             except OutOfPages:
+                self._allocator.release(shared)
                 break  # wait for running requests to finish
             self._waiting.pop(0)
             row = self._free_rows.pop()
             req.row, req.pages, req.state = row, pages, "prefilling"
+            # cache hit: prefill resumes after the shared pages' tokens
+            req.cached_tokens = len(shared) * self.page_size
+            req.prefill_pos = req.cached_tokens
+            req.seq_len = req.cached_tokens
+            req.pages_registered = len(shared)
+            if shared:
+                self._allocator.hit_tokens += req.cached_tokens
             self._row_req[row] = req
             self._block_tables[row, : len(pages)] = pages
-            self._seq_lens[row] = 0
+            self._seq_lens[row] = req.cached_tokens
             # device-side decode guard: a burst may never scatter past this
             # row's allocated pages (nor past the cache-length cap)
             self._row_limits[row] = min(len(pages) * self.page_size, self.max_seq_len - 1)
             self._set_row_sampling(row, req.sampling)
+            if req.cached_tokens:
+                # the skipped prefix still counts for repetition penalty:
+                # mark its tokens in the presence mask (fixed [1, max_seq]
+                # shape -> one compiled program regardless of hit length)
+                ids = np.zeros((1, self.max_seq_len), dtype=np.int32)
+                ids[0, : req.cached_tokens] = req.prompt[: req.cached_tokens]
+                self._presence = _mark_presence_chunks(
+                    self._presence,
+                    jnp.asarray([row], dtype=jnp.int32),
+                    jnp.asarray(ids),
+                    jnp.asarray([req.cached_tokens], dtype=jnp.int32),
+                    self.cfg.vocab_size,
+                )
         prefilling = [r for r in self._row_req.values() if r.state == "prefilling"]
         if not prefilling:
             return False
@@ -410,6 +448,15 @@ class Engine:
             req.prefill_pos += valids[i]
             req.seq_len = req.prefill_pos
             self._seq_lens[req.row] = req.seq_len
+            if self.prefix_caching:
+                # publish every prompt page this chunk completed: its KV is
+                # final (decode writes land past the prompt), so identical
+                # prefixes admitted from now on skip recomputing it
+                full = min(req.prefill_pos // self.page_size, len(req.page_hashes))
+                while req.pages_registered < full:
+                    j = req.pages_registered
+                    self._allocator.register(req.page_hashes[j], req.pages[j])
+                    req.pages_registered = j + 1
             if req.prefill_pos >= len(req.prompt):
                 done_idx.append(i)
 
@@ -667,6 +714,16 @@ class Engine:
         for nb in buckets:
             prompts = [[1, 2, 3]] * nb
             self.generate(prompts, sp)
+        if self.prefix_caching:
+            # the cached-prefix presence-marking program ([1, max_seq] shape)
+            # only runs on cache hits; compile it now with a zero-length mark
+            self._presence = _mark_presence_chunks(
+                self._presence,
+                jnp.zeros((1,), dtype=jnp.int32),
+                jnp.zeros((1, self.max_seq_len), dtype=jnp.int32),
+                jnp.zeros((1,), dtype=jnp.int32),
+                self.cfg.vocab_size,
+            )
         logger.info("engine warmup complete (%d prefill row buckets)", len(buckets))
 
     def generate(

@@ -74,6 +74,127 @@ class PageAllocator:
     def release(self, pages: list[int]) -> None:
         self._free.extend(pages)
 
+    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0) -> bool:
+        """Interface parity with PrefixCachingAllocator (no cache here)."""
+        return self.free_count + extra_free >= need
+
+
+def page_hashes(prompt: list[int], page_size: int) -> list[bytes]:
+    """Chain hash per FULL page of the prompt: h_i = H(h_{i-1} || tokens_i).
+    Chaining makes a page's identity its full token prefix, so equal hashes
+    imply byte-identical KV content (vLLM's automatic-prefix-caching block
+    hash)."""
+    import hashlib
+
+    out: list[bytes] = []
+    prev = b""
+    for start in range(0, len(prompt) - page_size + 1, page_size):
+        chunk = np.asarray(prompt[start : start + page_size], dtype=np.int64).tobytes()
+        prev = hashlib.blake2b(prev + chunk, digest_size=16).digest()
+        out.append(prev)
+    return out
+
+
+class PrefixCachingAllocator:
+    """Refcounting page allocator with an automatic prefix cache.
+
+    Every allocated page carries a refcount.  ``register`` associates a page
+    with its prefix chain hash once its KV content is final (prefill wrote
+    the whole page); ``share`` hands an admission the longest run of cached
+    pages matching its prompt's chain, bumping refcounts instead of
+    recomputing prefill.  Pages released to refcount 0 whose hash is
+    registered park in an LRU instead of the free list — ``allocate`` evicts
+    from the LRU only when the free list runs dry, so "free" HBM doubles as
+    prefix cache (exactly vLLM's automatic prefix caching economics: cache
+    capacity is whatever the pool isn't actively using).
+
+    Drop-in superset of ``PageAllocator``: ``free_count`` counts evictable
+    cached pages as free, so the engine's admission accounting is unchanged.
+    """
+
+    def __init__(self, num_pages: int) -> None:
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        self.num_pages = num_pages
+        self._rc: dict[int, int] = {}
+        self._hash_to_page: dict[bytes, int] = {}
+        self._page_to_hash: dict[int, bytes] = {}
+        # zero-ref cached pages, least-recently-used first (dict = ordered)
+        self._lru: dict[int, None] = {}
+        self.hit_tokens = 0  # stats: prompt tokens served from cache
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free) + len(self._lru)
+
+    def allocate(self, n: int) -> list[int]:
+        if n > self.free_count:
+            raise OutOfPages(f"need {n} pages, {self.free_count} free")
+        out: list[int] = []
+        for _ in range(n):
+            if self._free:
+                page = self._free.pop()
+            else:  # evict the coldest cached page
+                page = next(iter(self._lru))
+                del self._lru[page]
+                h = self._page_to_hash.pop(page)
+                del self._hash_to_page[h]
+            self._rc[page] = 1
+            out.append(page)
+        return out
+
+    def release(self, pages: list[int]) -> None:
+        for page in pages:
+            rc = self._rc.get(page, 0) - 1
+            if rc > 0:
+                self._rc[page] = rc
+                continue
+            self._rc.pop(page, None)
+            if page in self._page_to_hash:
+                self._lru[page] = None  # park: evictable but instantly reusable
+            else:
+                self._free.append(page)
+
+    # ---------------------------------------------------------- prefix API --
+
+    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0) -> bool:
+        """Would ``share(hashes)`` + ``allocate(need - matched)`` succeed
+        right now (plus ``extra_free`` pages the caller could recycle first)?
+        Matched pages that are parked in the LRU must not double-count as
+        allocatable free pages — sharing removes them from the LRU."""
+        matched = parked = 0
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            matched += 1
+            if page in self._lru:
+                parked += 1
+        avail = len(self._free) + len(self._lru) - parked + extra_free
+        return avail >= need - matched
+
+    def share(self, hashes: list[bytes]) -> list[int]:
+        """Claim the longest cached run matching ``hashes``: refcounts bump,
+        parked pages leave the LRU.  Returns the shared pages in order."""
+        out: list[int] = []
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            if page in self._lru:
+                del self._lru[page]
+            self._rc[page] = self._rc.get(page, 0) + 1
+            out.append(page)
+        return out
+
+    def register(self, h: bytes, page: int) -> None:
+        """Publish a fully-written page under its chain hash.  First writer
+        wins: if the hash is already served by another page (a concurrent
+        twin prefilled the same prefix), this page simply stays private."""
+        if h in self._hash_to_page or page in self._page_to_hash:
+            return
+        self._hash_to_page[h] = page
+        self._page_to_hash[page] = h
+
 
 def pages_needed(num_tokens: int, page_size: int) -> int:
     return -(-num_tokens // page_size)

@@ -1,0 +1,218 @@
+"""Automatic prefix caching: page-aligned KV reuse across requests
+(the in-tree analog of vLLM's --enable-prefix-caching; the reference's
+engine is vLLM itself, helm/templates/qwen-deployment.yaml:21-33).
+
+Covers: allocator refcount/LRU mechanics, hit accounting, token-identical
+outputs vs an uncached engine (including repetition-penalty sampling, which
+depends on presence marks for the *skipped* prefix), shared-prefix fan-out,
+concurrent twins, and eviction under pool pressure.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.serving import Engine, SamplingParams
+from githubrepostorag_tpu.serving.kv_cache import (
+    OutOfPages,
+    PrefixCachingAllocator,
+    page_hashes,
+)
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from githubrepostorag_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg.to_dict())
+    params = params_from_state_dict(model.state_dict(), cfg)
+    return model, params, cfg
+
+
+def _engine(params, cfg, **kw):
+    defaults = dict(
+        max_num_seqs=4, num_pages=64, page_size=8, max_seq_len=128,
+        prefill_chunk=32, kv_dtype=jnp.float32, decode_burst=4,
+    )
+    defaults.update(kw)
+    return Engine(params, cfg, **defaults)
+
+
+# ------------------------------------------------------------- allocator --
+
+
+def test_page_hashes_chain_identity():
+    ps = 4
+    a = page_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], ps)
+    b = page_hashes([1, 2, 3, 4, 5, 6, 7, 8], ps)
+    assert len(a) == 2 and len(b) == 2
+    assert a == b  # same full pages -> same chain
+    # a different token in page 0 changes EVERY downstream hash
+    c = page_hashes([9, 2, 3, 4, 5, 6, 7, 8], ps)
+    assert c[0] != b[0] and c[1] != b[1]
+    # same page-1 tokens under a different prefix do not collide
+    assert len([1, 2, 3]) == 3 and page_hashes([1, 2, 3], ps) == []
+
+
+def test_allocator_share_refcount_lru_evict():
+    al = PrefixCachingAllocator(4)
+    h = page_hashes(list(range(8)), 4)  # two pages
+    pages = al.allocate(2)
+    al.register(h[0], pages[0])
+    al.register(h[1], pages[1])
+    # second claimant shares, refcount 2
+    shared = al.share(h)
+    assert shared == pages
+    al.release(pages)  # first owner leaves -> rc 1, still live
+    assert al.free_count == 2
+    al.release(pages)  # second leaves -> rc 0, parked in LRU (still cached)
+    assert al.free_count == 4
+    # a new match revives the parked pages
+    again = al.share(h)
+    assert again == pages
+    al.release(again)
+    # exhaust the pool: parked cached pages get evicted for fresh allocation
+    fresh = al.allocate(4)
+    assert sorted(fresh) == [0, 1, 2, 3]
+    assert al.share(h) == []  # evicted -> no longer matchable
+    with pytest.raises(OutOfPages):
+        al.allocate(1)
+    al.release(fresh)
+    assert al.free_count == 4
+
+
+def test_can_admit_accounts_for_parked_matches():
+    """Matched pages parked in the LRU must not double-count as allocatable
+    free pages — sharing them removes them from the evictable set."""
+    al = PrefixCachingAllocator(4)
+    h = page_hashes(list(range(8)), 4)
+    pages = al.allocate(2)
+    al.register(h[0], pages[0])
+    al.register(h[1], pages[1])
+    al.release(pages)  # both parked in LRU; 2 pages on the free list
+    assert al.can_admit(h, 4)  # share 2 parked + allocate 2 free: exact fit
+    assert not al.can_admit(h, 5)  # would need 3 fresh, only 2 free remain
+    assert al.can_admit([], 4)  # no sharing: all 4 are allocatable
+    assert not al.can_admit([], 5) and al.can_admit([], 5, extra_free=1)
+
+
+def test_allocator_register_first_writer_wins():
+    al = PrefixCachingAllocator(4)
+    h = page_hashes(list(range(4)), 4)
+    a = al.allocate(1)
+    b = al.allocate(1)
+    al.register(h[0], a[0])
+    al.register(h[0], b[0])  # concurrent twin: mapping keeps the first page
+    assert al.share(h) == a
+    al.release(a)  # the share's ref
+    al.release(a)  # the owner's ref -> parked
+    al.release(b)  # unregistered page goes straight to the free list
+    assert al.free_count == 4
+
+
+# ---------------------------------------------------------------- engine --
+
+
+def test_repeat_prompt_hits_cache_and_matches_uncached(tiny):
+    _, params, cfg = tiny
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, size=40).tolist()  # 5 full pages
+    # repetition penalty active: outputs depend on presence marks for the
+    # SKIPPED prefix — the regression this test pins down
+    sp = SamplingParams(max_tokens=12, temperature=0.0, stop_token_ids=(),
+                        repetition_penalty=1.3)
+
+    ref = _engine(params, cfg, prefix_caching=False)
+    expected = ref.generate([prompt], sp)[0].output_tokens
+
+    eng = _engine(params, cfg)
+    first = eng.generate([prompt], sp)[0].output_tokens
+    assert eng._allocator.hit_tokens == 0
+    second = eng.generate([prompt], sp)[0].output_tokens
+    # (40-1)//8 = 4 pages = 32 tokens served from cache on the repeat
+    assert eng._allocator.hit_tokens == 32
+    assert first == expected
+    assert second == expected
+
+
+def test_shared_prefix_fanout_matches_uncached(tiny):
+    """RAG shape: one long shared system/context prefix, different tails."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(1)
+    prefix = rng.integers(0, cfg.vocab_size, size=24).tolist()  # 3 full pages
+    tails = [rng.integers(0, cfg.vocab_size, size=n).tolist() for n in (5, 9, 13)]
+    prompts = [prefix + t for t in tails]
+    sp = SamplingParams(max_tokens=10, temperature=0.0, stop_token_ids=(),
+                        repetition_penalty=1.2)
+
+    ref = _engine(params, cfg, prefix_caching=False)
+    expected = [r.output_tokens for r in ref.generate(prompts, sp)]
+
+    eng = _engine(params, cfg)
+    seed = eng.generate([prompts[0]], sp)[0].output_tokens
+    assert seed == expected[0]
+    rest = [r.output_tokens for r in eng.generate(prompts[1:], sp)]
+    assert rest == expected[1:]
+    # both followers reused the 3-page (24-token) prefix
+    assert eng._allocator.hit_tokens == 48
+
+
+def test_concurrent_identical_prompts_correct(tiny):
+    """Twins admitted in the same wave: the second may or may not share
+    (registration is chunk-granular) but outputs must be identical."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, cfg.vocab_size, size=40).tolist()
+    sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+    eng = _engine(params, cfg)
+    results = eng.generate([prompt, prompt], sp)
+    assert results[0].output_tokens == results[1].output_tokens
+    solo = _engine(params, cfg, prefix_caching=False).generate([prompt], sp)[0]
+    assert results[0].output_tokens == solo.output_tokens
+
+
+def test_cache_survives_page_pressure_and_accounting_balances(tiny):
+    """Fill the pool with distinct prompts until eviction must happen, then
+    re-run the first prompt; every request completes and the allocator ends
+    balanced (free_count == num_pages)."""
+    _, params, cfg = tiny
+    eng = _engine(params, cfg, num_pages=16, max_num_seqs=2, max_seq_len=64)
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, cfg.vocab_size, size=24).tolist() for _ in range(6)]
+    for p in prompts:
+        eng.generate([p], sp)
+    assert eng.generate([prompts[0]], sp)[0].output_tokens  # after eviction churn
+    assert eng._allocator.free_count == eng._allocator.num_pages
+    assert not eng.has_work()
+
+
+def test_cached_prefix_skips_prefill_compute(tiny):
+    """The repeat run must dispatch fewer prefill chunks: its prefill starts
+    at the cached boundary."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, cfg.vocab_size, size=64).tolist()  # 8 pages
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    eng = _engine(params, cfg, prefill_chunk=16)  # 4 chunks uncached
+    eng.generate([prompt], sp)
+    req_id = eng.add_request(prompt, sp)
+    req = eng._requests[req_id]
+    eng.step()  # admission happens here
+    # (64-1)//8 = 7 pages cached -> prefill starts at 56, one chunk left
+    assert req.cached_tokens == 56
+    while eng.has_work():
+        eng.step()
+    assert len(req.output) == 4
