@@ -106,6 +106,8 @@ class Engine:
         decode_burst: int = 8,
         mesh=None,  # jax.sharding.Mesh -> TP-shard params, KV pools, compute
         prefix_caching: bool = True,  # vLLM automatic-prefix-caching analog
+        sp_prefill_threshold: int | None = None,  # prompts this long prefill
+        # sequence-parallel over the mesh's sp axis (serving/long_prefill.py)
     ) -> None:
         self.mesh = mesh
         if mesh is not None:
@@ -151,6 +153,9 @@ class Engine:
         self._allocator = (
             PrefixCachingAllocator(num_pages) if prefix_caching else PageAllocator(num_pages)
         )
+        self.sp_prefill_threshold = sp_prefill_threshold
+        self._sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+        self.sp_prefills = 0  # stats: prompts served by the ring-prefill path
 
         # host-side batch state
         self._block_tables = np.zeros((max_num_seqs, self.max_pages_per_seq), dtype=np.int32)
@@ -287,6 +292,30 @@ class Engine:
                 self._release(req)
                 finished.append(self._result(req, "cancelled"))
 
+    def _register_full_pages(self, req: _Request) -> None:
+        """Publish every prompt page prefill has completed so far: its KV is
+        final (decode writes land past the prompt), so identical prefixes
+        admitted from now on skip recomputing it.  Shared by the chunked and
+        sp-prefill paths."""
+        if not self.prefix_caching:
+            return
+        if not req.page_hashes:
+            req.page_hashes = page_hashes(req.prompt, self.page_size)
+        full = min(req.prefill_pos // self.page_size, len(req.page_hashes))
+        while req.pages_registered < full:
+            j = req.pages_registered
+            self._allocator.register(req.page_hashes[j], req.pages[j])
+            req.pages_registered = j + 1
+
+    def _sp_eligible(self, req: _Request) -> bool:
+        """Long prompts take the sequence-parallel ring-prefill path: the
+        whole prompt in one program, attention sharded over sp."""
+        return (
+            self.sp_prefill_threshold is not None
+            and self._sp > 1
+            and len(req.prompt) >= self.sp_prefill_threshold
+        )
+
     def _head_need_hashes(self, req: _Request) -> tuple[int, list[bytes]]:
         """Total page need for ``req`` and the chain hashes of the prefix
         pages an admission would be allowed to share (capped so at least one
@@ -295,7 +324,10 @@ class Engine:
             min(len(req.prompt) + req.sampling.max_tokens, self.max_seq_len), self.page_size
         )
         hashes: list[bytes] = []
-        if self.prefix_caching:
+        # ring prefill runs the prompt from position 0 in one program — it
+        # cannot resume at a cached boundary, so sp-bound prompts skip the
+        # prefix cache (they may still REGISTER their pages for others)
+        if self.prefix_caching and not self._sp_eligible(req):
             if not req.page_hashes:
                 req.page_hashes = page_hashes(req.prompt, self.page_size)
             shareable = min(len(req.page_hashes), (len(req.prompt) - 1) // self.page_size)
@@ -313,7 +345,10 @@ class Engine:
         req = self._waiting[0]
         need, hashes = self._head_need_hashes(req)
         rows_avail = bool(self._free_rows) or bool(self._deferred)
-        extra = sum(len(pages) for _, pages in self._deferred)
+        # only deferred pages nobody else shares actually free on drain
+        extra = sum(
+            self._allocator.releasable_count(pages) for _, pages in self._deferred
+        )
         return rows_avail and self._allocator.can_admit(hashes, need, extra_free=extra)
 
     def _try_prefill(self, finished: list[GenerationResult]) -> bool:
@@ -376,7 +411,12 @@ class Engine:
         prefilling = [r for r in self._row_req.values() if r.state == "prefilling"]
         if not prefilling:
             return False
-        self._prefill_batch(prefilling, finished)
+        long_reqs = [r for r in prefilling if self._sp_eligible(r) and r.prefill_pos == 0]
+        for req in long_reqs:
+            self._sp_prefill(req, finished)
+            prefilling.remove(req)
+        if prefilling:
+            self._prefill_batch(prefilling, finished)
         return True
 
     # ------------------------------------------------------------ compute --
@@ -448,15 +488,7 @@ class Engine:
             req.prefill_pos += valids[i]
             req.seq_len = req.prefill_pos
             self._seq_lens[req.row] = req.seq_len
-            if self.prefix_caching:
-                # publish every prompt page this chunk completed: its KV is
-                # final (decode writes land past the prompt), so identical
-                # prefixes admitted from now on skip recomputing it
-                full = min(req.prefill_pos // self.page_size, len(req.page_hashes))
-                while req.pages_registered < full:
-                    j = req.pages_registered
-                    self._allocator.register(req.page_hashes[j], req.pages[j])
-                    req.pages_registered = j + 1
+            self._register_full_pages(req)
             if req.prefill_pos >= len(req.prompt):
                 done_idx.append(i)
 
@@ -491,6 +523,64 @@ class Engine:
                 self._commit_token(req, int(tokens[i]), finished)
         else:
             self._pending_first.append((tokens_d, wave))
+
+    def _sp_prefill(self, req: _Request, finished: list[GenerationResult]) -> None:
+        """Whole-prompt sequence-parallel prefill: one ring-attention program
+        over the sp axis computes every position's attention and commits all
+        prompt K/V to this row's pages (serving/long_prefill.py).  The first
+        token samples from the returned last-position logits and joins the
+        decode batch exactly like a chunked-prefill completion."""
+        from githubrepostorag_tpu.serving.long_prefill import ring_prefill
+
+        n = len(req.prompt)
+        width = _bucket(n, self.max_seq_len, minimum=self._sp)
+        width = -(-width // self._sp) * self._sp  # shard_map needs sp | width
+        ids = np.zeros((1, width), dtype=np.int32)
+        ids[0, :n] = req.prompt
+        pos = np.broadcast_to(np.arange(width, dtype=np.int32), (1, width))
+        slots = slot_mapping(
+            self._block_tables[req.row], 0, n, self.page_size, width
+        )[None]
+        with annotate("engine.sp_prefill"):
+            logits, self._k_pages, self._v_pages = ring_prefill(
+                self.params, self.cfg,
+                jnp.asarray(ids), jnp.asarray(pos),
+                self._k_pages, self._v_pages,
+                jnp.asarray(slots), jnp.asarray([n - 1], dtype=jnp.int32),
+                self.mesh,
+            )
+        self.sp_prefills += 1
+        req.prefill_pos = req.seq_len = n
+        self._seq_lens[req.row] = n
+
+        # whole prompt into the repetition-penalty presence mask (the same
+        # fixed [1, max_seq] program the cached-prefix path uses)
+        ids_full = np.zeros((1, self.max_seq_len), dtype=np.int32)
+        ids_full[0, :n] = req.prompt
+        row_d = jnp.asarray([req.row], dtype=jnp.int32)
+        self._presence = _mark_presence_chunks(
+            self._presence, row_d, jnp.asarray(ids_full),
+            jnp.asarray([n], dtype=jnp.int32), self.cfg.vocab_size,
+        )
+        # can't RESUME from the cache, but others can resume from us
+        self._register_full_pages(req)
+
+        self._push_sampling()
+        self._rng, key = jax.random.split(self._rng)
+        tokens_d = sample_tokens(
+            logits[:, 0], key,
+            self._temp_d[row_d], self._top_p_d[row_d], self._top_k_d[row_d],
+            self._rep_pen_d[row_d], self._presence[row_d],
+        )
+        self._presence = _mark_presence_rows(self._presence, row_d, tokens_d)
+        req.state = "running"
+        others_running = any(
+            r.state == "running" and r is not req for r in self._row_req.values()
+        )
+        if self._chain is None and not others_running:
+            self._commit_token(req, int(np.asarray(tokens_d)[0]), finished)
+        else:
+            self._pending_first.append((tokens_d, [(req, 0)]))
 
     def _decode_step(self, finished: list[GenerationResult]) -> None:
         """One decode dispatch: a fused burst of up to ``self.decode_burst``

@@ -78,6 +78,10 @@ class PageAllocator:
         """Interface parity with PrefixCachingAllocator (no cache here)."""
         return self.free_count + extra_free >= need
 
+    def releasable_count(self, pages: list[int]) -> int:
+        """Interface parity: without refcounts every page frees on release."""
+        return len(pages)
+
 
 def page_hashes(prompt: list[int], page_size: int) -> list[bytes]:
     """Chain hash per FULL page of the prompt: h_i = H(h_{i-1} || tokens_i).
@@ -143,7 +147,11 @@ class PrefixCachingAllocator:
         return out
 
     def release(self, pages: list[int]) -> None:
-        for page in pages:
+        # park TAIL-first: a chain is only matchable from its head, so the
+        # head must be the last thing eviction takes (evict-leaf-first) —
+        # parking in block-table order would evict h0 first and strand the
+        # whole still-parked chain as unmatchable
+        for page in reversed(pages):
             rc = self._rc.get(page, 0) - 1
             if rc > 0:
                 self._rc[page] = rc
@@ -153,6 +161,11 @@ class PrefixCachingAllocator:
                 self._lru[page] = None  # park: evictable but instantly reusable
             else:
                 self._free.append(page)
+
+    def releasable_count(self, pages: list[int]) -> int:
+        """How many of ``pages`` would actually reach the allocatable set if
+        released now (pages other requests still share won't)."""
+        return sum(1 for p in pages if self._rc.get(p, 1) <= 1)
 
     # ---------------------------------------------------------- prefix API --
 

@@ -1,0 +1,102 @@
+"""Sequence-parallel long-context prefill: the whole prompt in ONE device
+program with ring attention over the ``sp`` mesh axis, K/V committed to the
+paged pools.
+
+The reference *avoids* long context (vLLM ``--max-model-len 11712`` plus a
+truncation cascade — SURVEY.md §5.7); this path is what makes long prompts a
+scaling axis instead of a cap.  Chunked prefill already bounds single-chip
+memory, but its attention work is serial in the chunk count; here the
+sequence axis is sharded over ``sp``: each device keeps its contiguous query
+shard resident, K/V shards rotate around the ring over ICI
+(parallel/ring_attention.py — ppermute + online softmax, exact causal), and
+every layer's K/V shards are scattered into the page pools once at the end.
+Decode then proceeds on the standard paged path, so a long-context request
+is only special for its first step.
+
+Logits are projected at the prompt's last token only: a full [1, S, V]
+projection at S=32k is gigabytes of HBM for one row.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.models.qwen2 import (
+    Qwen2Config,
+    _block,
+    _embed_dtype,
+    _logits,
+)
+from githubrepostorag_tpu.models.quant import embedding_lookup
+from githubrepostorag_tpu.ops.norms import rms_norm
+from githubrepostorag_tpu.ops.rope import rope_cos_sin
+from githubrepostorag_tpu.parallel.ring_attention import make_ring_attend
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"), donate_argnums=(4, 5))
+def ring_prefill(
+    params: dict,
+    cfg: Qwen2Config,
+    input_ids: jnp.ndarray,  # [1, Sp] int32, right-padded; Sp % mesh sp == 0
+    positions: jnp.ndarray,  # [1, Sp] int32
+    k_pages: jnp.ndarray,  # [L, n_kv, P, page_size, hd] (donated)
+    v_pages: jnp.ndarray,  # (donated)
+    slot_mapping: jnp.ndarray,  # [1, Sp] int32 flat pool slots, -1 padding
+    last_idx: jnp.ndarray,  # [1] int32 — index of the last real token
+    mesh,  # jax.sharding.Mesh with sp > 1 (tp composes; heads shard when divisible)
+):
+    """Prefill an entire prompt sequence-parallel and write its KV pages.
+
+    Returns (logits [1, 1, V] float32, k_pages, v_pages).  Padding tokens
+    sit AFTER the last real token, so causal masking keeps them out of every
+    real position's attention, and their K/V carry slot -1 (dropped by the
+    scatter).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nkv, hd = cfg.num_kv_heads, cfg.head_dim
+    L = cfg.num_layers
+    num_pages, page_size = k_pages.shape[2], k_pages.shape[3]
+    total_slots = num_pages * page_size
+
+    attend = make_ring_attend(
+        mesh, num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads
+    )
+    # pin the sequence axis onto sp so the dense program around the ring
+    # (embeddings, QKV/MLP matmuls) shards the same way shard_map expects
+    input_ids = jax.lax.with_sharding_constraint(
+        input_ids, NamedSharding(mesh, P(None, "sp"))
+    )
+
+    h = embedding_lookup(params["embed"], input_ids, dtype=_embed_dtype(params))
+    cos, sin = rope_cos_sin(positions, hd, cfg.rope_theta)
+
+    def body(h, layer_xs):
+        (p,) = layer_xs
+        # capture each layer's post-RoPE K/V as scan outputs — exactly what
+        # the paged cache stores (models/qwen2.py forward_paged writes the
+        # same tensors chunk by chunk)
+        h, kv = _block(cfg, h, p, cos, sin, lambda q, k, v: (attend(q, k, v), (k, v)))
+        return h, kv
+
+    h, (ks, vs) = jax.lax.scan(body, h, (params["layers"],))
+    h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
+    h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)  # [1, 1, d]
+    logits = _logits(params, h_last)
+
+    flat_slots = slot_mapping.reshape(-1)  # [Sp]
+    # negative (padding) slots would WRAP in a JAX scatter; send them out of
+    # range so mode="drop" discards them
+    flat_slots = jnp.where(flat_slots < 0, total_slots, flat_slots)
+
+    def commit(pools, stacked):
+        # stacked [L, 1, Sp, n_kv, hd] -> [L, n_kv, Sp, hd] matching the
+        # flat [L, n_kv, P*ps, hd] pool view
+        flat = pools.reshape(L, nkv, total_slots, hd)
+        vals = stacked[:, 0].transpose(0, 2, 1, 3).astype(pools.dtype)
+        return flat.at[:, :, flat_slots].set(vals, mode="drop").reshape(pools.shape)
+
+    return logits, commit(k_pages, ks), commit(v_pages, vs)

@@ -1,0 +1,149 @@
+"""Sequence-parallel (ring attention) long-context prefill in serving:
+token parity with the chunked single-device path, pool-content parity, and
+mixed long+short scheduling.  Runs on the virtual 8-device CPU mesh.
+
+Reference contrast: the reference caps context (vLLM --max-model-len 11712,
+SURVEY.md §5.7) — this path *scales* it over the sp mesh axis instead.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
+from githubrepostorag_tpu.serving import Engine, SamplingParams
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from githubrepostorag_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg.to_dict())
+    params = params_from_state_dict(model.state_dict(), cfg)
+    return model, params, cfg
+
+
+def _engine(params, cfg, **kw):
+    defaults = dict(
+        max_num_seqs=4, num_pages=64, page_size=8, max_seq_len=256,
+        prefill_chunk=32, kv_dtype=jnp.float32, decode_burst=4,
+    )
+    defaults.update(kw)
+    return Engine(params, cfg, **defaults)
+
+
+def _sp_engine(params, cfg, threshold=40, **kw):
+    return _engine(
+        params, cfg, mesh=make_mesh(MeshPlan(sp=2)),
+        sp_prefill_threshold=threshold, **kw,
+    )
+
+
+def test_ring_prefill_token_parity_with_chunked(tiny):
+    model, params, cfg = tiny
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, size=48).tolist()
+    sp = SamplingParams(max_tokens=12, temperature=0.0, stop_token_ids=(),
+                        repetition_penalty=1.2)
+
+    expected = _engine(params, cfg).generate([prompt], sp)[0].output_tokens
+
+    eng = _sp_engine(params, cfg)
+    got = eng.generate([prompt], sp)[0].output_tokens
+    assert eng.sp_prefills == 1, "prompt above threshold must ride the sp path"
+    assert got == expected
+
+    # HF ground truth too: the ring path must match the reference model
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        hf = model.generate(ids, max_new_tokens=12, do_sample=False,
+                            pad_token_id=0, eos_token_id=None,
+                            repetition_penalty=1.2, use_cache=True)
+    assert got == hf[0, len(prompt):].tolist()
+
+
+def test_ring_prefill_pool_contents_match_chunked(tiny):
+    """The KV pages the ring path writes must equal the chunked path's —
+    decode after a ring prefill reads the same cache bytes."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, size=56).tolist()
+    sp = SamplingParams(max_tokens=1, temperature=0.0, stop_token_ids=())
+
+    eng_a = _engine(params, cfg)
+    eng_b = _sp_engine(params, cfg)
+    eng_a.generate([prompt], sp)
+    eng_b.generate([prompt], sp)
+    assert eng_b.sp_prefills == 1
+    # same admission order -> same allocator decisions -> same block tables
+    k_a, k_b = np.asarray(eng_a._k_pages), np.asarray(eng_b._k_pages)
+    v_a, v_b = np.asarray(eng_a._v_pages), np.asarray(eng_b._v_pages)
+    np.testing.assert_allclose(k_a, k_b, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(v_a, v_b, rtol=1e-5, atol=1e-5)
+
+
+def test_short_prompts_stay_on_chunked_path(tiny):
+    _, params, cfg = tiny
+    prompt = list(range(1, 21))  # 20 tokens < threshold 40
+    sp = SamplingParams(max_tokens=6, temperature=0.0, stop_token_ids=())
+    eng = _sp_engine(params, cfg)
+    expected = _engine(params, cfg).generate([prompt], sp)[0].output_tokens
+    assert eng.generate([prompt], sp)[0].output_tokens == expected
+    assert eng.sp_prefills == 0
+
+
+def test_mixed_long_short_continuous_batching(tiny):
+    """A long prompt admitted while a short stream decodes: both must match
+    their solo runs and the long one must use the sp path."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(2)
+    short = [1, 2, 3, 4]
+    long_p = rng.integers(0, cfg.vocab_size, size=64).tolist()
+    sp = SamplingParams(max_tokens=10, temperature=0.0, stop_token_ids=())
+
+    solo_short = _engine(params, cfg).generate([short], sp)[0].output_tokens
+    solo_long = _engine(params, cfg).generate([long_p], sp)[0].output_tokens
+
+    eng = _sp_engine(params, cfg)
+    r1 = eng.add_request(short, sp)
+    for _ in range(2):
+        eng.step()
+    r2 = eng.add_request(long_p, sp)
+    done = {}
+    while eng.has_work():
+        for res in eng.step():
+            done[res.request_id] = res
+    assert eng.sp_prefills == 1
+    assert done[r1].output_tokens == solo_short
+    assert done[r2].output_tokens == solo_long
+
+
+def test_sp_prefill_registers_prefix_for_chunked_followers(tiny):
+    """A ring-prefilled prompt publishes its pages: a later SHORT prompt
+    sharing the prefix (below the sp threshold) resumes from the cache."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(0, cfg.vocab_size, size=24).tolist()
+    long_p = prefix + rng.integers(0, cfg.vocab_size, size=24).tolist()  # 48
+    short_p = prefix + [7, 8, 9]  # 27 tokens, chunked path
+    sp = SamplingParams(max_tokens=6, temperature=0.0, stop_token_ids=())
+
+    eng = _sp_engine(params, cfg, threshold=40)
+    eng.generate([long_p], sp)
+    assert eng.sp_prefills == 1
+    expected = _engine(params, cfg).generate([short_p], sp)[0].output_tokens
+    got = eng.generate([short_p], sp)[0].output_tokens
+    assert got == expected
+    assert eng._allocator.hit_tokens == 24  # 3 pages resumed from the cache

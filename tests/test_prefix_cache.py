@@ -93,6 +93,33 @@ def test_allocator_share_refcount_lru_evict():
     assert al.free_count == 4
 
 
+def test_eviction_takes_chain_tail_first():
+    """Chains match head-first, so eviction must consume them tail-first:
+    after evicting one page of a parked 2-page chain, the head must still
+    be shareable (parking in block-table order would strand the whole
+    chain)."""
+    al = PrefixCachingAllocator(2)
+    h = page_hashes(list(range(8)), 4)
+    pages = al.allocate(2)
+    al.register(h[0], pages[0])
+    al.register(h[1], pages[1])
+    al.release(pages)
+    assert al.allocate(1) == [pages[1]]  # tail evicted, head survives
+    assert al.share(h) == [pages[0]]
+
+
+def test_releasable_count_excludes_shared_pages():
+    al = PrefixCachingAllocator(4)
+    h = page_hashes(list(range(8)), 4)
+    pages = al.allocate(2)
+    al.register(h[0], pages[0])
+    al.register(h[1], pages[1])
+    other = al.share(h)  # rc 2 on both
+    assert al.releasable_count(pages) == 0  # releasing us frees nothing
+    al.release(other)
+    assert al.releasable_count(pages) == 2
+
+
 def test_can_admit_accounts_for_parked_matches():
     """Matched pages parked in the LRU must not double-count as allocatable
     free pages — sharing them removes them from the evictable set."""
