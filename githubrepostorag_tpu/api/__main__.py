@@ -29,7 +29,7 @@ def _build_llm():
         from githubrepostorag_tpu.models.hf_loader import load_qwen2
         from githubrepostorag_tpu.serving import Engine
         from githubrepostorag_tpu.serving.async_engine import AsyncEngine
-        from githubrepostorag_tpu.serving.tokenizer import HFTokenizer
+        from githubrepostorag_tpu.serving.tokenizer import make_tokenizer
 
         if not s.model_weights_path:
             raise SystemExit("LLM_BACKEND=inprocess requires MODEL_WEIGHTS_PATH")
@@ -47,7 +47,7 @@ def _build_llm():
             prefill_chunk=s.prefill_chunk,
             use_pallas=jax.default_backend() == "tpu",
         )
-        return InProcessLLM(AsyncEngine(engine), HFTokenizer(s.model_weights_path))
+        return InProcessLLM(AsyncEngine(engine), make_tokenizer(s.model_weights_path))
     from githubrepostorag_tpu.llm import get_llm
 
     return get_llm()

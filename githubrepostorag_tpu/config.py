@@ -119,6 +119,11 @@ class Settings:
     kv_num_pages: int = field(default_factory=lambda: _env_int("KV_NUM_PAGES", 2048))
     max_num_seqs: int = field(default_factory=lambda: _env_int("MAX_NUM_SEQS", 64))
     prefill_chunk: int = field(default_factory=lambda: _env_int("PREFILL_CHUNK", 512))
+    # "native" = in-tree C++ byte-level BPE (serving/bpe_native.py) when the
+    # checkpoint has a tokenizer.json; "hf" = transformers AutoTokenizer
+    tokenizer_backend: str = field(
+        default_factory=lambda: os.getenv("TOKENIZER_BACKEND", "native")
+    )
 
     @property
     def scope_tables(self) -> dict[str, str]:

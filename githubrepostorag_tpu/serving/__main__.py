@@ -28,7 +28,7 @@ async def serve(host: str, port: int) -> None:
     from githubrepostorag_tpu.serving.async_engine import AsyncEngine
     from githubrepostorag_tpu.serving.engine import Engine
     from githubrepostorag_tpu.serving.openai_api import OpenAIServer
-    from githubrepostorag_tpu.serving.tokenizer import HFTokenizer
+    from githubrepostorag_tpu.serving.tokenizer import make_tokenizer
 
     from githubrepostorag_tpu.parallel import (
         MeshPlan,
@@ -65,6 +65,10 @@ async def serve(host: str, port: int) -> None:
                 "run more server pods to use them)", n - plan.tp
             )
 
+    # tokenizer first: a broken tokenizer config must fail fast, not after
+    # minutes of XLA warmup compiles
+    tokenizer = make_tokenizer(s.model_weights_path)
+    logger.info("tokenizer: %s", type(tokenizer).__name__)
     engine = Engine(
         params, cfg,
         max_num_seqs=s.max_num_seqs,
@@ -78,7 +82,7 @@ async def serve(host: str, port: int) -> None:
     logger.info("precompiling engine programs (prefill buckets + decode burst)")
     engine.warmup()
     server = OpenAIServer(
-        AsyncEngine(engine), HFTokenizer(s.model_weights_path), model_name=s.qwen_model
+        AsyncEngine(engine), tokenizer, model_name=s.qwen_model
     )
     bound = await server.start(host=host, port=port)
     logger.info("model server up on %s:%d (backend=%s)", host, bound, jax.default_backend())

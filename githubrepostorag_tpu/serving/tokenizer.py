@@ -91,6 +91,37 @@ class HFTokenizer:
         )
 
 
+def make_tokenizer(model_dir: str, backend: str | None = None) -> "Tokenizer":
+    """Tokenizer for a local checkpoint dir: the in-tree C++/Python BPE when
+    ``tokenizer.json`` is a byte-level BPE (no transformers import at all),
+    else the transformers adapter.  ``backend`` overrides
+    Settings.tokenizer_backend ("native" | "hf")."""
+    import os
+
+    if backend is None:
+        from githubrepostorag_tpu.config import get_settings
+
+        backend = get_settings().tokenizer_backend
+    tj = os.path.join(model_dir, "tokenizer.json")
+    if backend == "native" and os.path.isfile(tj):
+        try:
+            from githubrepostorag_tpu.serving.bpe_native import NativeBPETokenizer
+
+            tok = NativeBPETokenizer(tj)
+            # serving renders chat prompts: only select the native tokenizer
+            # when its ChatML template matches this vocab's markers
+            tok.apply_chat_template([{"role": "user", "content": "probe"}])
+            return tok
+        except Exception as exc:  # noqa: BLE001 - non-BPE json, unusual spec,
+            # unsupported normalizer, undeterminable eos, non-ChatML vocab
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native BPE load failed for %s (%s); using transformers", tj, exc
+            )
+    return HFTokenizer(model_dir)
+
+
 class StreamingDetokenizer:
     """Incremental decode that never emits half a UTF-8 codepoint (the
     reference never streams at all — qwen_llm.py:149-151 fakes it)."""

@@ -1,0 +1,140 @@
+// In-tree byte-level BPE merge engine.
+//
+// The reference stack tokenizes through HuggingFace `tokenizers` (a Rust
+// native dependency pulled in by transformers); this is the TPU framework's
+// own native tokenizer core: the O(n log n) merge loop that dominates
+// encode time, exposed over a tiny C ABI consumed via ctypes
+// (githubrepostorag_tpu/serving/bpe_native.py).  Pre-tokenization (the
+// unicode regex split) stays in Python where unicode tables live; each
+// pre-tokenized segment's bytes come here.
+//
+// Algorithm: classic heap-driven BPE. Each segment starts as a doubly
+// linked list of single-byte tokens; adjacent pairs with a known merge sit
+// in a min-heap keyed by merge rank; popping applies the lowest-rank merge,
+// splices the list, and pushes the two freshly-created neighbour pairs.
+// Stale heap entries (about nodes already merged away) are skipped on pop
+// (lazy invalidation) by re-checking the pair against the live list.
+//
+// Build: make -C native libbpe.so
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Bpe {
+  // (left_id << 32 | right_id) -> (rank << 32 | merged_id)
+  std::unordered_map<uint64_t, uint64_t> merges;
+  int32_t byte_to_id[256];
+};
+
+struct Node {
+  int32_t id;
+  int32_t prev;
+  int32_t next;
+  bool alive;
+};
+
+struct HeapItem {
+  uint32_t rank;
+  int32_t pos;        // index of the left node at push time
+  int32_t left, right;  // pair identity at push time (staleness check)
+  bool operator>(const HeapItem& o) const {
+    // rank first; position breaks ties left-to-right like HF tokenizers
+    return rank != o.rank ? rank > o.rank : pos > o.pos;
+  }
+};
+
+inline uint64_t pair_key(int32_t a, int32_t b) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(a)) << 32) |
+         static_cast<uint32_t>(b);
+}
+
+int encode_segment(const Bpe& bpe, const uint8_t* bytes, int len,
+                   int32_t* out) {
+  if (len <= 0) return 0;
+  std::vector<Node> nodes(len);
+  for (int i = 0; i < len; ++i) {
+    nodes[i] = {bpe.byte_to_id[bytes[i]], i - 1, i + 1, true};
+  }
+  nodes[len - 1].next = -1;
+
+  std::priority_queue<HeapItem, std::vector<HeapItem>, std::greater<HeapItem>>
+      heap;
+  auto push_pair = [&](int32_t pos) {
+    int32_t nxt = nodes[pos].next;
+    if (nxt < 0) return;
+    auto it = bpe.merges.find(pair_key(nodes[pos].id, nodes[nxt].id));
+    if (it == bpe.merges.end()) return;
+    heap.push({static_cast<uint32_t>(it->second >> 32), pos, nodes[pos].id,
+               nodes[nxt].id});
+  };
+  for (int i = 0; i < len - 1; ++i) push_pair(i);
+
+  while (!heap.empty()) {
+    HeapItem top = heap.top();
+    heap.pop();
+    int32_t pos = top.pos;
+    if (!nodes[pos].alive || nodes[pos].id != top.left) continue;
+    int32_t nxt = nodes[pos].next;
+    if (nxt < 0 || nodes[nxt].id != top.right) continue;
+    auto it = bpe.merges.find(pair_key(top.left, top.right));
+    // found at push time; still present (merges are immutable)
+    nodes[pos].id = static_cast<int32_t>(it->second & 0xffffffffu);
+    nodes[pos].next = nodes[nxt].next;
+    nodes[nxt].alive = false;
+    if (nodes[pos].next >= 0) nodes[nodes[pos].next].prev = pos;
+    if (nodes[pos].prev >= 0) push_pair(nodes[pos].prev);
+    push_pair(pos);
+  }
+
+  int n = 0;
+  for (int i = 0; i >= 0; i = nodes[i].next) out[n++] = nodes[i].id;
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// merge_pairs: [n_merges * 2] (left_id, right_id) in rank order;
+// merged_ids: [n_merges]; byte_to_id: [256] initial id per raw byte.
+void* bpe_new(const int32_t* merge_pairs, const int32_t* merged_ids,
+              int32_t n_merges, const int32_t* byte_to_id) {
+  Bpe* bpe = new Bpe();
+  bpe->merges.reserve(static_cast<size_t>(n_merges) * 2);
+  for (int32_t r = 0; r < n_merges; ++r) {
+    uint64_t key = pair_key(merge_pairs[2 * r], merge_pairs[2 * r + 1]);
+    // first (lowest-rank) definition of a pair wins, as in HF tokenizers
+    bpe->merges.emplace(key, (static_cast<uint64_t>(r) << 32) |
+                                 static_cast<uint32_t>(merged_ids[r]));
+  }
+  std::memcpy(bpe->byte_to_id, byte_to_id, sizeof(bpe->byte_to_id));
+  return bpe;
+}
+
+// text: raw bytes; seg_offsets: [n_segs + 1] byte offsets of pre-tokenized
+// segments; out: caller-sized to len(text) (one token per byte worst case);
+// seg_counts (nullable): [n_segs] tokens emitted per segment, so the caller
+// can interleave segments it resolved itself (ignore_merges whole-vocab
+// hits).  Returns total tokens written.
+int32_t bpe_encode(void* handle, const uint8_t* text,
+                   const int32_t* seg_offsets, int32_t n_segs, int32_t* out,
+                   int32_t* seg_counts) {
+  const Bpe& bpe = *static_cast<Bpe*>(handle);
+  int32_t n = 0;
+  for (int32_t s = 0; s < n_segs; ++s) {
+    int32_t wrote = encode_segment(bpe, text + seg_offsets[s],
+                                   seg_offsets[s + 1] - seg_offsets[s], out + n);
+    if (seg_counts) seg_counts[s] = wrote;
+    n += wrote;
+  }
+  return n;
+}
+
+void bpe_free(void* handle) { delete static_cast<Bpe*>(handle); }
+
+}  // extern "C"
