@@ -71,17 +71,19 @@ def _token_str_to_bytes(token: str) -> bytes:
 
 def _load_library() -> ctypes.CDLL | None:
     lib_path = _NATIVE_DIR / _LIB_NAME
-    if not lib_path.exists():
-        if not (_NATIVE_DIR / "bpe.cpp").exists():
-            return None
-        try:  # lazy one-shot build; failure is non-fatal
+    if (_NATIVE_DIR / "bpe.cpp").exists():
+        try:  # make every time: dependency-tracked no-op when fresh, and a
+            # stale .so (edited bpe.cpp, or a binary built on another host
+            # with -march=native) must never be loaded silently
             subprocess.run(
                 ["make", "-C", str(_NATIVE_DIR), _LIB_NAME],
                 check=True, capture_output=True, timeout=120,
             )
         except (subprocess.SubprocessError, OSError) as exc:
-            logger.warning("native bpe build failed, using python merges: %s", exc)
-            return None
+            logger.warning("native bpe build failed: %s", exc)
+    if not lib_path.exists():
+        logger.warning("no %s, using python merges", _LIB_NAME)
+        return None
     try:
         lib = ctypes.CDLL(str(lib_path))
         I32P = ctypes.POINTER(ctypes.c_int32)
@@ -107,7 +109,16 @@ class NativeBPETokenizer:
     (the Qwen2 family's — SURVEY.md §2.1 serving model rows).
     """
 
-    def __init__(self, tokenizer_json: str | Path, use_native: bool = True) -> None:
+    def __init__(
+        self,
+        tokenizer_json: str | Path,
+        use_native: bool = True,
+        default_system: str | None = None,
+    ) -> None:
+        # injected into chats that carry no system turn (Qwen2's template
+        # does this — see from_checkpoint, which extracts the checkpoint's
+        # own default); None = render exactly the provided messages
+        self.default_system = default_system
         path = Path(tokenizer_json)
         spec = json.loads(path.read_text())
         model = spec["model"]
@@ -157,7 +168,7 @@ class NativeBPETokenizer:
         self._pattern = self._find_pattern(spec)
         import regex
 
-        self._re = regex.compile(self._pattern)
+        self._re = regex.compile(self._pattern) if self._pattern else None
         self._specials_re = (
             regex.compile("|".join(regex.escape(s) for s in sorted(
                 self.specials, key=len, reverse=True)))
@@ -184,6 +195,38 @@ class NativeBPETokenizer:
             lib.bpe_free(handle)
 
     # ------------------------------------------------------------- loading --
+
+    @classmethod
+    def from_checkpoint(cls, model_dir: str | Path, **kw) -> "NativeBPETokenizer":
+        """Build from a checkpoint dir, honoring its chat template's default
+        system prompt.  If tokenizer_config.json carries a chat_template,
+        the template must contain a recognizable ChatML default-system
+        literal (`<|im_start|>system\\n...<|im_end|>` with plain text
+        inside, as Qwen2's does) — otherwise the template's semantics are
+        unknown and we raise so make_tokenizer uses transformers instead of
+        silently rendering a different prompt than the checkpoint expects."""
+        import re as _re
+
+        model_dir = Path(model_dir)
+        cfg_path = model_dir / "tokenizer_config.json"
+        default_system = None
+        if cfg_path.is_file():
+            template = json.loads(cfg_path.read_text()).get("chat_template")
+            if template:
+                # jinja string literals carry "\n" as backslash-n
+                for m in _re.finditer(
+                    r"<\|im_start\|>system(?:\\n|\n)(.*?)<\|im_end\|>", template, _re.S
+                ):
+                    content = m.group(1)
+                    if not any(ch in content for ch in "{}'\"+"):
+                        default_system = content
+                        break
+                else:
+                    raise ValueError(
+                        "chat_template present but no ChatML default-system "
+                        "literal found — template semantics unknown"
+                    )
+        return cls(model_dir / "tokenizer.json", default_system=default_system, **kw)
 
     @staticmethod
     def _parse_normalizer(node) -> list[str]:
@@ -224,22 +267,55 @@ class NativeBPETokenizer:
         )
 
     @staticmethod
-    def _find_pattern(spec: dict) -> str:
+    def _find_pattern(spec: dict) -> str | None:
         """The split regex from the pre_tokenizer config (Qwen2 keeps it in
-        a Split node; plain ByteLevel implies the GPT-2 pattern)."""
-        def walk(node):
-            if not isinstance(node, dict):
-                return None
-            if node.get("type") == "Split":
-                pat = node.get("pattern", {})
-                return pat.get("Regex") or pat.get("String")
-            for sub in node.get("pretokenizers", []) or []:
-                found = walk(sub)
-                if found:
-                    return found
-            return None
+        a Split node; plain ByteLevel implies the GPT-2 pattern).  STRICT:
+        semantics this implementation doesn't reproduce (add_prefix_space,
+        Split.invert, delimiter-dropping behaviors, non-byte-level
+        pre-tokenizers) raise, so make_tokenizer falls back to the
+        transformers adapter instead of silently mis-tokenizing.  Returns
+        None for no pre_tokenizer at all (whole text = one segment)."""
+        import regex
 
-        return walk(spec.get("pre_tokenizer") or {}) or GPT2_PATTERN
+        node = spec.get("pre_tokenizer")
+        if node is None:
+            return None
+        found: list[str] = []
+
+        def walk(n):
+            t = n.get("type")
+            if t == "Sequence":
+                for sub in n.get("pretokenizers", []):
+                    walk(sub)
+            elif t == "Split":
+                if n.get("invert"):
+                    raise ValueError("unsupported pre_tokenizer: Split.invert")
+                if n.get("behavior", "Isolated") != "Isolated":
+                    raise ValueError(
+                        f"unsupported Split.behavior {n.get('behavior')!r} "
+                        "(only Isolated keeps all text)"
+                    )
+                pat = n.get("pattern", {})
+                if "Regex" in pat:
+                    found.append(pat["Regex"])
+                elif "String" in pat:
+                    found.append(regex.escape(pat["String"]))
+                else:
+                    raise ValueError(f"unsupported Split.pattern {pat!r}")
+            elif t == "ByteLevel":
+                if n.get("add_prefix_space"):
+                    raise ValueError(
+                        "unsupported pre_tokenizer: ByteLevel.add_prefix_space"
+                    )
+                if n.get("use_regex", True):
+                    found.append(GPT2_PATTERN)
+            else:
+                raise ValueError(f"unsupported pre_tokenizer type {t!r}")
+
+        walk(node)
+        if len(found) > 1 and len(set(found)) > 1:
+            raise ValueError("multiple conflicting split patterns in pre_tokenizer")
+        return found[0] if found else None
 
     # ------------------------------------------------------------ encoding --
 
@@ -254,14 +330,17 @@ class NativeBPETokenizer:
         # unicode regex split; characters the pattern skips become their own
         # segments so byte offsets never misalign
         segs: list[str] = []
-        last = 0
-        for m in self._re.finditer(text):
-            if m.start() > last:
-                segs.append(text[last : m.start()])
-            segs.append(m.group())
-            last = m.end()
-        if last < len(text):
-            segs.append(text[last:])
+        if self._re is None:  # no pre_tokenizer: the whole text is one segment
+            segs.append(text)
+        else:
+            last = 0
+            for m in self._re.finditer(text):
+                if m.start() > last:
+                    segs.append(text[last : m.start()])
+                segs.append(m.group())
+                last = m.end()
+            if last < len(text):
+                segs.append(text[last:])
 
         # per segment: a whole-vocab hit (ignore_merges) resolves here; the
         # rest batch into one native call (or the python merge loop)
@@ -357,6 +436,10 @@ class NativeBPETokenizer:
                 "ChatML (Qwen2-family) template; use the transformers adapter "
                 "for checkpoints with other chat templates"
             )
+        if self.default_system is not None and (
+            not messages or messages[0].get("role") != "system"
+        ):
+            messages = [{"role": "system", "content": self.default_system}] + messages
         parts = [
             f"<|im_start|>{m['role']}\n{m['content']}<|im_end|>\n" for m in messages
         ]

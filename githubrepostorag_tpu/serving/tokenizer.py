@@ -107,13 +107,14 @@ def make_tokenizer(model_dir: str, backend: str | None = None) -> "Tokenizer":
         try:
             from githubrepostorag_tpu.serving.bpe_native import NativeBPETokenizer
 
-            tok = NativeBPETokenizer(tj)
+            tok = NativeBPETokenizer.from_checkpoint(model_dir)
             # serving renders chat prompts: only select the native tokenizer
             # when its ChatML template matches this vocab's markers
             tok.apply_chat_template([{"role": "user", "content": "probe"}])
             return tok
         except Exception as exc:  # noqa: BLE001 - non-BPE json, unusual spec,
-            # unsupported normalizer, undeterminable eos, non-ChatML vocab
+            # unsupported normalizer/pre-tokenizer, undeterminable eos,
+            # non-ChatML vocab or unrecognizable chat template
             import logging
 
             logging.getLogger(__name__).warning(

@@ -28,11 +28,10 @@ _LIB_NAME = "libvecsearch.so"
 
 def _load_library() -> ctypes.CDLL | None:
     lib_path = _NATIVE_DIR / _LIB_NAME
-    if not lib_path.exists():
-        src = _NATIVE_DIR / "vecsearch.cpp"
-        if not src.exists():
-            return None
-        try:  # lazy one-shot build; failure is non-fatal
+    if (_NATIVE_DIR / "vecsearch.cpp").exists():
+        try:  # make every time: dependency-tracked no-op when fresh, and a
+            # stale .so (edited source, or a binary built on another host
+            # with -march=native) must never be loaded silently
             subprocess.run(
                 ["make", "-C", str(_NATIVE_DIR), _LIB_NAME],
                 check=True,
@@ -40,8 +39,10 @@ def _load_library() -> ctypes.CDLL | None:
                 timeout=120,
             )
         except (subprocess.SubprocessError, OSError) as exc:
-            logger.warning("native vecsearch build failed, using numpy path: %s", exc)
-            return None
+            logger.warning("native vecsearch build failed: %s", exc)
+    if not lib_path.exists():
+        logger.warning("no %s, using numpy path", _LIB_NAME)
+        return None
     try:
         lib = ctypes.CDLL(str(lib_path))
         lib.topk_cosine.argtypes = [

@@ -215,6 +215,80 @@ def test_eos_refused_when_undeterminable(trained, tmp_path):
         NativeBPETokenizer(mod)
 
 
+def test_default_system_from_chat_template(trained, tmp_path):
+    """from_checkpoint extracts the checkpoint's default system prompt from
+    a Qwen2-style chat_template and injects it when chats carry no system
+    turn — matching what transformers' template rendering would do."""
+    import json
+    import shutil
+
+    path, _ = trained
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    shutil.copy(path, ckpt / "tokenizer.json")
+    template = (
+        "{%- if messages[0]['role'] == 'system' %}"
+        "{{- '<|im_start|>system\\n' + messages[0]['content'] + '<|im_end|>\\n' }}"
+        "{%- else %}"
+        "{{- '<|im_start|>system\\nYou are a helpful assistant.<|im_end|>\\n' }}"
+        "{%- endif %}"
+    )
+    (ckpt / "tokenizer_config.json").write_text(json.dumps(
+        {"eos_token": "<|im_end|>", "chat_template": template}
+    ))
+    tok = NativeBPETokenizer.from_checkpoint(ckpt)
+    assert tok.default_system == "You are a helpful assistant."
+    rendered = tok.apply_chat_template([{"role": "user", "content": "hi"}])
+    assert rendered.startswith("<|im_start|>system\nYou are a helpful assistant.")
+    # explicit system turn wins
+    rendered = tok.apply_chat_template(
+        [{"role": "system", "content": "be terse"}, {"role": "user", "content": "hi"}]
+    )
+    assert "You are a helpful" not in rendered and "be terse" in rendered
+
+
+def test_unrecognizable_chat_template_rejected(trained, tmp_path):
+    import json
+    import shutil
+
+    path, _ = trained
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    shutil.copy(path, ckpt / "tokenizer.json")
+    (ckpt / "tokenizer_config.json").write_text(json.dumps(
+        {"chat_template": "{% for m in messages %}[{{m.role}}]{{m.content}}{% endfor %}"}
+    ))
+    with pytest.raises(ValueError, match="template"):
+        NativeBPETokenizer.from_checkpoint(ckpt)
+
+
+def test_add_prefix_space_rejected(trained, tmp_path):
+    """RoBERTa-style add_prefix_space changes every first-word id; we don't
+    implement it, so the loader must refuse (-> transformers fallback)."""
+    import json
+
+    path, _ = trained
+    spec = json.loads(path.read_text())
+    spec["pre_tokenizer"] = {"type": "ByteLevel", "add_prefix_space": True,
+                             "trim_offsets": True, "use_regex": True}
+    mod = tmp_path / "tokenizer.json"
+    mod.write_text(json.dumps(spec))
+    with pytest.raises(ValueError, match="add_prefix_space"):
+        NativeBPETokenizer(mod)
+
+
+def test_unknown_pretokenizer_rejected(trained, tmp_path):
+    import json
+
+    path, _ = trained
+    spec = json.loads(path.read_text())
+    spec["pre_tokenizer"] = {"type": "Whitespace"}
+    mod = tmp_path / "tokenizer.json"
+    mod.write_text(json.dumps(spec))
+    with pytest.raises(ValueError, match="pre_tokenizer"):
+        NativeBPETokenizer(mod)
+
+
 def test_long_input_stability(trained, native):
     _, hf = trained
     text = " ".join(CORPUS) * 8
