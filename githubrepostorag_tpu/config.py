@@ -124,6 +124,15 @@ class Settings:
     tokenizer_backend: str = field(
         default_factory=lambda: os.getenv("TOKENIZER_BACKEND", "native")
     )
+    # automatic prefix caching (page-aligned KV reuse across requests)
+    prefix_caching: bool = field(
+        default_factory=lambda: _env_bool("PREFIX_CACHING", True)
+    )
+    # prompts at least this long prefill sequence-parallel over the mesh's
+    # sp axis (serving/long_prefill.py); 0 disables
+    sp_prefill_threshold: int = field(
+        default_factory=lambda: _env_int("SP_PREFILL_THRESHOLD", 0)
+    )
 
     @property
     def scope_tables(self) -> dict[str, str]:

@@ -33,6 +33,11 @@ class BertConfig:
 
     @classmethod
     def e5_small(cls) -> "BertConfig":
+        """intfloat/e5-small-v2 geometry — also BAAI/bge-small-en-v1.5's
+        (BASELINE eval config #2): both are 12-layer/384-hidden BERTs, and
+        real checkpoints load through JaxBertTextEncoder.from_pretrained,
+        which reads the geometry from config.json (embedding.py applies e5
+        query/passage prefixes only when the model name says e5)."""
         return cls()
 
     @classmethod

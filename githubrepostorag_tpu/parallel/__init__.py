@@ -32,6 +32,7 @@ from githubrepostorag_tpu.parallel.mesh import (
     MeshPlan,
     make_mesh,
     plan_for_devices,
+    plan_from_string,
 )
 from githubrepostorag_tpu.parallel.ring_attention import make_ring_attend, ring_attention
 from githubrepostorag_tpu.parallel.sharding import (
@@ -47,6 +48,7 @@ __all__ = [
     "MeshPlan",
     "make_mesh",
     "plan_for_devices",
+    "plan_from_string",
     "qwen2_param_specs",
     "encoder_param_specs",
     "shard_params",

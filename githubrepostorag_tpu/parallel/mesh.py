@@ -108,6 +108,24 @@ def plan_for_devices(
     raise ValueError(f"unknown role {role!r}")
 
 
+def plan_from_string(spec: str) -> MeshPlan:
+    """Parse the MESH_SHAPE env format: ``"dp:2,tp:4"`` (axes omitted are
+    size 1).  The operator's explicit override of ``plan_for_devices``."""
+    axes: dict[str, int] = {}
+    for part in spec.replace(" ", "").split(","):
+        if not part:
+            continue
+        name, _, size = part.partition(":")
+        if name not in AXIS_NAMES or not size.isdigit() or int(size) < 1:
+            raise ValueError(
+                f"bad MESH_SHAPE entry {part!r}: want axis:size with axis in {AXIS_NAMES}"
+            )
+        if name in axes:  # "tp:4,tp:2" is a typo, not a request for tp=2
+            raise ValueError(f"bad MESH_SHAPE: axis {name!r} given twice")
+        axes[name] = int(size)
+    return MeshPlan(**axes)
+
+
 def _pow2_floor(x: int) -> int:
     p = 1
     while p * 2 <= x:

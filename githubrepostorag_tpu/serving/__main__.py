@@ -51,18 +51,26 @@ async def serve(host: str, port: int) -> None:
 
     # TP-shard the decoder over the chip's ICI mesh (vLLM's
     # --tensor-parallel-size equivalent; reference runs TP=1 on one GPU —
-    # helm/templates/qwen-deployment.yaml:44-46)
+    # helm/templates/qwen-deployment.yaml:44-46).  MESH_SHAPE overrides the
+    # automatic plan (e.g. "tp:4,sp:2" to also enable sequence-parallel
+    # long-prompt prefill).
     n = len(jax.devices())
-    plan = plan_for_devices(
-        n, num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads, role="serve"
-    )
-    mesh = make_mesh(MeshPlan(tp=plan.tp)) if plan.tp > 1 else None
+    if s.mesh_shape:
+        from githubrepostorag_tpu.parallel import plan_from_string
+
+        plan = plan_from_string(s.mesh_shape)
+    else:
+        plan = plan_for_devices(
+            n, num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads, role="serve"
+        )
+        plan = MeshPlan(tp=plan.tp)
+    mesh = make_mesh(plan) if plan.n_devices > 1 else None
     if mesh is not None:
-        logger.info("tensor-parallel serving over tp=%d of %d devices", plan.tp, n)
-        if plan.tp < n:
+        logger.info("serving mesh %s over %d devices", dict(mesh.shape), n)
+        if plan.n_devices < n:
             logger.info(
                 "%d devices idle (DP serving = one engine replica per group; "
-                "run more server pods to use them)", n - plan.tp
+                "run more server pods to use them)", n - plan.n_devices
             )
 
     # tokenizer first: a broken tokenizer config must fail fast, not after
@@ -78,6 +86,8 @@ async def serve(host: str, port: int) -> None:
         prefill_chunk=s.prefill_chunk,
         use_pallas=jax.default_backend() == "tpu",
         mesh=mesh,
+        prefix_caching=s.prefix_caching,
+        sp_prefill_threshold=s.sp_prefill_threshold or None,
     )
     logger.info("precompiling engine programs (prefill buckets + decode burst)")
     engine.warmup()

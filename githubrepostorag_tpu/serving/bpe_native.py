@@ -69,7 +69,18 @@ def _token_str_to_bytes(token: str) -> bytes:
     return bytes(u2b[ch] for ch in token)
 
 
+_LIB_CACHE: ctypes.CDLL | None | bool = False  # False = not yet attempted
+
+
 def _load_library() -> ctypes.CDLL | None:
+    global _LIB_CACHE
+    if _LIB_CACHE is not False:  # memoized (possibly as None)
+        return _LIB_CACHE
+    _LIB_CACHE = _load_library_uncached()
+    return _LIB_CACHE
+
+
+def _load_library_uncached() -> ctypes.CDLL | None:
     lib_path = _NATIVE_DIR / _LIB_NAME
     if (_NATIVE_DIR / "bpe.cpp").exists():
         try:  # make every time: dependency-tracked no-op when fresh, and a
@@ -80,7 +91,10 @@ def _load_library() -> ctypes.CDLL | None:
                 check=True, capture_output=True, timeout=120,
             )
         except (subprocess.SubprocessError, OSError) as exc:
-            logger.warning("native bpe build failed: %s", exc)
+            # do NOT fall through to a stale binary we couldn't refresh —
+            # it may have been built for another host's ISA
+            logger.warning("native bpe build failed, using python merges: %s", exc)
+            return None
     if not lib_path.exists():
         logger.warning("no %s, using python merges", _LIB_NAME)
         return None
@@ -219,7 +233,9 @@ class NativeBPETokenizer:
                 ):
                     content = m.group(1)
                     if not any(ch in content for ch in "{}'\"+"):
-                        default_system = content
+                        # jinja string literals carry newlines as backslash-n;
+                        # render them as the template engine would
+                        default_system = content.replace("\\n", "\n")
                         break
                 else:
                     raise ValueError(

@@ -39,7 +39,10 @@ def _load_library() -> ctypes.CDLL | None:
                 timeout=120,
             )
         except (subprocess.SubprocessError, OSError) as exc:
-            logger.warning("native vecsearch build failed: %s", exc)
+            # do NOT fall through to a stale binary we couldn't refresh —
+            # it may have been built for another host's ISA
+            logger.warning("native vecsearch build failed, using numpy path: %s", exc)
+            return None
     if not lib_path.exists():
         logger.warning("no %s, using numpy path", _LIB_NAME)
         return None

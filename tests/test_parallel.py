@@ -41,6 +41,22 @@ def test_mesh_axes_and_size():
     assert mesh.shape["pp"] == mesh.shape["ep"] == 1
 
 
+def test_plan_from_string():
+    from githubrepostorag_tpu.parallel import plan_from_string
+
+    assert plan_from_string("dp:2,tp:4") == MeshPlan(dp=2, tp=4)
+    assert plan_from_string("tp:4, sp:2") == MeshPlan(tp=4, sp=2)
+    assert plan_from_string("") == MeshPlan()
+    import pytest
+
+    with pytest.raises(ValueError, match="MESH_SHAPE"):
+        plan_from_string("tp:0")
+    with pytest.raises(ValueError, match="MESH_SHAPE"):
+        plan_from_string("xx:2")
+    with pytest.raises(ValueError, match="twice"):
+        plan_from_string("tp:4,tp:2")
+
+
 def test_plan_for_devices_respects_head_divisibility():
     # 14 q heads / 2 kv heads (Qwen2-0.5B): tp must fall back to 2
     plan = plan_for_devices(8, num_heads=14, num_kv_heads=2, role="serve")
