@@ -196,10 +196,13 @@ def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
 
 def bench_7b_int8() -> float:
     """Qwen2-7B geometry with int8 weight-only quantization on one chip
-    (models/quant.py), bs=8: the model the BASELINE targets are stated
-    for.  Random int8 weights built host-side (a bf16 7B tree cannot be
-    materialized on-chip to quantize); everything else — warmup, Pallas
-    fallback, medians — reuses bench_decode."""
+    (models/quant.py), bs=32: the model the BASELINE targets are stated
+    for.  Decode is weight-read bound, so batch rows are nearly free until
+    attention/sampling catch up — measured 598 tok/s at bs=8 vs
+    ~1.7k tok/s at bs=32 on one v5e chip.  Random int8 weights built
+    host-side (a bf16 7B tree cannot be materialized on-chip to quantize);
+    everything else — warmup, Pallas fallback, medians — reuses
+    bench_decode."""
     from githubrepostorag_tpu.models.quant import init_params_quantized, params_nbytes
     from githubrepostorag_tpu.models.qwen2 import Qwen2Config
 
@@ -208,12 +211,13 @@ def bench_7b_int8() -> float:
     params = init_params_quantized(cfg)
     jax.block_until_ready(params)
     log(f"bench[qwen2-7b-int8]: {params_nbytes(params) / 1e9:.2f} GB on chip; "
-        "compiling (~13 min)")
+        "compiling (~15 min)")
     # burst 32 (not 64): the 7B burst program's XLA compile time scales
     # with n_steps and already dominates this bench item
-    tps, _, _ = bench_decode(cfg, "qwen2-7b-int8", batch=8, prompt_len=128,
-                             gen_tokens=128, num_pages=40, page_size=256,
-                             max_seq=1024, params=params, decode_burst=32)
+    tps, _, _ = bench_decode(cfg, "qwen2-7b-int8", batch=32, prompt_len=128,
+                             gen_tokens=128, num_pages=160, page_size=256,
+                             max_seq=1024, params=params, decode_burst=32,
+                             runs=2)
     return tps
 
 
@@ -279,7 +283,7 @@ def _main() -> None:
         # LAST metric: its ~13 min XLA compile must not cost the others.)
         if os.environ.get("BENCH_7B", "1") != "0":
             tps7 = bench_7b_int8()
-            emit("decode_tok_s_per_chip_qwen2-7b_int8_bs8", tps7, "tok/s",
+            emit("decode_tok_s_per_chip_qwen2-7b_int8_bs32", tps7, "tok/s",
                  tps7 / BASELINE_TOK_S)
     else:  # CPU fallback so the script still demonstrates end to end
         cfg = Qwen2Config.tiny()

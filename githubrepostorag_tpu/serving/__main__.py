@@ -59,6 +59,15 @@ async def serve(host: str, port: int) -> None:
         from githubrepostorag_tpu.parallel import plan_from_string
 
         plan = plan_from_string(s.mesh_shape)
+        if plan.dp > 1 or plan.pp > 1 or plan.ep > 1:
+            # the serving engine shards over tp (params/pools/kernel) and sp
+            # (ring prefill) only; a dp/pp/ep axis would silently replicate
+            # every step's work across those chips
+            raise SystemExit(
+                f"MESH_SHAPE={s.mesh_shape!r}: serving uses tp and sp axes only "
+                "— for data-parallel serving run one server pod per replica "
+                "(each with its own tp/sp group)"
+            )
     else:
         plan = plan_for_devices(
             n, num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads, role="serve"

@@ -14,5 +14,20 @@ from githubrepostorag_tpu.training.step import (
     init_train_state,
     make_train_step,
 )
+from githubrepostorag_tpu.training.pipeline import (
+    init_pp_train_state,
+    make_pp_train_step,
+    merge_layers_from_pp,
+    split_layers_for_pp,
+)
 
-__all__ = ["TrainState", "causal_lm_loss", "init_train_state", "make_train_step"]
+__all__ = [
+    "TrainState",
+    "causal_lm_loss",
+    "init_pp_train_state",
+    "init_train_state",
+    "make_pp_train_step",
+    "make_train_step",
+    "merge_layers_from_pp",
+    "split_layers_for_pp",
+]

@@ -1,0 +1,231 @@
+"""Pipeline-parallel training over the ``pp`` mesh axis.
+
+Completes the parallel fabric: dp/tp/sp are annotation-driven
+(training/step.py), while pipelining needs an explicit schedule — this is
+the idiomatic JAX form of it.  The decoder's scanned layer stack
+[L, ...] splits into ``pp`` contiguous stages ([pp, L/pp, ...], leading
+axis sharded over the mesh); a GPipe schedule runs inside ONE
+``shard_map``-ped, jit-compiled, *differentiable* program:
+
+  - the batch splits into M microbatches; the schedule runs M + pp - 1
+    ticks of ``lax.scan``;
+  - every tick, each stage runs its local layers on the activation it
+    holds, then ``lax.ppermute`` hands the result one hop down the ring —
+    stage transfers ride ICI exactly like ring attention's K/V blocks;
+  - stage 0 ingests microbatch ``t`` at tick ``t``; the last stage
+    projects logits and accumulates the masked cross-entropy of microbatch
+    ``t - (pp-1)`` (a ``lax.cond`` skips the vocab projection on every
+    other stage/tick, so fill/drain bubbles cost layer-compute only);
+  - backward is plain ``jax.grad`` through the scan: ``ppermute``
+    transposes to the reverse rotation, giving the reverse-schedule
+    automatically; ``jax.checkpoint`` around each stage keeps one stage's
+    activations per in-flight microbatch.
+
+The reference has nothing to mirror (single GPU — SURVEY.md §2.3 lists
+PP as "No"); SURVEY required the mesh to be designed so PP can slot in,
+and this is the slot filled.  Pipeline-parallelism composes with dp for
+the batch dim; tp/sp composition inside a stage is future work (the specs
+exist in parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from githubrepostorag_tpu.models.qwen2 import (
+    Qwen2Config,
+    _block,
+    _logits,
+)
+from githubrepostorag_tpu.models.quant import embedding_lookup
+from githubrepostorag_tpu.ops.attention import dense_attention
+from githubrepostorag_tpu.ops.norms import rms_norm
+from githubrepostorag_tpu.ops.rope import rope_cos_sin
+
+
+def split_layers_for_pp(params: dict, pp: int) -> dict:
+    """[L, ...]-stacked layer params -> [pp, L/pp, ...] stages (leading axis
+    is the one shard_map shards over pp).  Non-layer params pass through."""
+    L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+    if L % pp:
+        raise ValueError(f"num_layers={L} must divide by pp={pp}")
+    staged = jax.tree.map(
+        lambda x: x.reshape(pp, L // pp, *x.shape[1:]), params["layers"]
+    )
+    return {**params, "layers": staged}
+
+
+def merge_layers_from_pp(params: dict) -> dict:
+    """Inverse of split_layers_for_pp (for checkpointing / eval reuse)."""
+    merged = jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+        params["layers"],
+    )
+    return {**params, "layers": merged}
+
+
+def make_pp_train_step(
+    cfg: Qwen2Config,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation | None = None,
+    *,
+    num_microbatches: int = 2,
+    remat: bool = True,
+) -> tuple[Callable, optax.GradientTransformation]:
+    """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``
+    with the layer stack pipelined over the mesh's ``pp`` axis.
+
+    ``params`` carry pp-SPLIT layers (see split_layers_for_pp).  ``batch``
+    is the usual dict of int32 [B, S] ``input_ids``/``targets``/``mask``
+    with B divisible by num_microbatches (and by mesh dp).
+    """
+    optimizer = optimizer or optax.adamw(1e-4)
+    pp = mesh.shape["pp"]
+    dp = mesh.shape.get("dp", 1)
+    M = num_microbatches
+    if pp < 2:
+        raise ValueError("make_pp_train_step needs a pp>=2 mesh axis")
+    for axis in ("tp", "sp"):
+        if mesh.shape.get(axis, 1) != 1:
+            raise ValueError(f"pp step composes with dp only (got {axis}>1)")
+
+    n_ticks = M + pp - 1
+    mb_spec = P(None, "dp") if dp > 1 else P()  # [M, B/M, S]: batch over dp
+
+    def pp_loss(layers_local, embed, norm, lm_head, ids, targets, mask):
+        """shard_map body.  layers_local: [1, L/pp, ...] this stage's slice;
+        ids/targets/mask: [M, mb, S] microbatches (replicated over pp)."""
+        layers_local = jax.tree.map(lambda x: x[0], layers_local)  # [L/pp,...]
+        p_idx = lax.axis_index("pp")
+        last = pp - 1
+        mb, S = ids.shape[1], ids.shape[2]
+        head = {"embed": embed, "norm": norm}
+        if lm_head is not None:
+            head["lm_head"] = lm_head
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
+        cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+        attend = lambda q, k, v: (
+            dense_attention(q, k, v, causal=True, q_offset=0), None
+        )
+
+        def run_stage(x):
+            def layer_body(h, xs):
+                (pl,) = xs
+                h, _ = _block(cfg, h, pl, cos, sin, attend)
+                return h, None
+
+            if remat:
+                layer_body = jax.checkpoint(layer_body)
+            h, _ = lax.scan(layer_body, x, (layers_local,))
+            return h
+
+        def tick(carry, t):
+            buf, loss_sum, tok_sum = carry
+            # stage 0 ingests microbatch t (clamped; post-M garbage drains
+            # past the loss window and is never scored)
+            ids_t = ids[jnp.clip(t, 0, M - 1)]
+            x0 = embedding_lookup(embed, ids_t, dtype=buf.dtype)
+            x_in = jnp.where(p_idx == 0, x0, buf)
+            y = run_stage(x_in)
+
+            # the last stage just finished microbatch t-(pp-1)
+            done = t - last
+            is_done = (p_idx == last) & (done >= 0) & (done < M)
+            d_idx = jnp.clip(done, 0, M - 1)
+
+            def score(y):
+                h = rms_norm(y, norm, cfg.rms_norm_eps)
+                logits = _logits(head, h)  # [mb, S, V] f32
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets[d_idx]
+                )
+                msk = mask[d_idx].astype(jnp.float32)
+                return (losses * msk).sum(), msk.sum()
+
+            l, n = lax.cond(is_done, score, lambda y: (0.0, 0.0), y)
+
+            buf_next = lax.ppermute(
+                y, "pp", [(i, (i + 1) % pp) for i in range(pp)]
+            )
+            return (buf_next, loss_sum + l, tok_sum + n), None
+
+        buf0 = jnp.zeros((mb, S, cfg.hidden_size), dtype=embed.dtype)
+        (_, loss_sum, tok_sum), _ = lax.scan(
+            tick, (buf0, 0.0, 0.0), jnp.arange(n_ticks)
+        )
+        loss_sum = lax.psum(loss_sum, "pp")
+        tok_sum = lax.psum(tok_sum, "pp")
+        if dp > 1:
+            loss_sum = lax.psum(loss_sum, "dp")
+            tok_sum = lax.psum(tok_sum, "dp")
+        return loss_sum / jnp.maximum(tok_sum, 1.0)
+
+    # layers: leading (stage) axis over pp; head params replicated;
+    # microbatches replicated over pp, batch-dim over dp
+    shard_body = jax.shard_map(
+        pp_loss,
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P(), mb_spec, mb_spec, mb_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss_fn(params, batch):
+        b, S = batch["input_ids"].shape
+        if b % M:
+            raise ValueError(f"batch {b} must divide by num_microbatches {M}")
+        to_mb = lambda x: x.reshape(M, b // M, S)
+        return shard_body(
+            params["layers"],
+            params["embed"],
+            params["norm"],
+            params.get("lm_head"),
+            to_mb(batch["input_ids"]),
+            to_mb(batch["targets"]),
+            to_mb(batch["mask"]),
+        )
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, optimizer
+
+
+def init_pp_train_state(
+    cfg: Qwen2Config,
+    mesh: Mesh,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+    dtype=jnp.float32,
+):
+    """Random-init params pp-split onto the mesh (stage axis over pp, head
+    replicated) with an opt state inheriting the shardings."""
+    from jax.sharding import NamedSharding
+
+    from githubrepostorag_tpu.models.qwen2 import init_params
+    from githubrepostorag_tpu.training.step import TrainState
+
+    pp = mesh.shape["pp"]
+    params = split_layers_for_pp(init_params(cfg, key, dtype=dtype), pp)
+    staged = NamedSharding(mesh, P("pp"))
+    replicated = NamedSharding(mesh, P())
+    params = {
+        k: jax.tree.map(lambda x: jax.device_put(x, staged), v)
+        if k == "layers"
+        else jax.tree.map(lambda x: jax.device_put(x, replicated), v)
+        for k, v in params.items()
+    }
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(params=params, opt_state=opt_state)

@@ -1,0 +1,126 @@
+"""Pipeline-parallel training (training/pipeline.py): the GPipe schedule
+over the pp mesh axis must produce the SAME loss and the SAME updated
+params as the plain (non-pipelined) train step — pipelining is a schedule,
+not a model change.  Runs on the virtual 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
+from githubrepostorag_tpu.training import init_train_state, make_train_step
+from githubrepostorag_tpu.training.pipeline import (
+    init_pp_train_state,
+    make_pp_train_step,
+    merge_layers_from_pp,
+    split_layers_for_pp,
+)
+
+
+def _batch(cfg, b, s, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, (b, s), dtype=np.int32)
+    return {
+        "input_ids": jnp.asarray(ids),
+        "targets": jnp.asarray(np.roll(ids, -1, axis=1)),
+        "mask": jnp.ones((b, s), dtype=jnp.int32),
+    }
+
+
+def _ref_step(cfg, batch, optimizer):
+    """Non-pipelined single-device reference: same loss + update."""
+    mesh = make_mesh(MeshPlan())  # 1 device
+    step, _ = make_train_step(cfg, mesh, optimizer, remat=False)
+    state = init_train_state(cfg, mesh, jax.random.PRNGKey(0), optimizer)
+    params, _, loss = step(state.params, state.opt_state, batch)
+    return state, params, loss
+
+
+@pytest.mark.parametrize("pp,microbatches", [(2, 2), (2, 4), (4, 4)])
+def test_pp_loss_and_update_match_reference(pp, microbatches):
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=4, num_heads=4, num_kv_heads=2, head_dim=8,
+        rope_theta=10000.0, tie_word_embeddings=True,
+    )
+    optimizer = optax.sgd(1e-2)  # deterministic update, no moment noise
+    batch = _batch(cfg, b=4, s=16)
+    _, ref_params, ref_loss = _ref_step(cfg, batch, optimizer)
+
+    mesh = make_mesh(MeshPlan(pp=pp))
+    step, _ = make_pp_train_step(
+        cfg, mesh, optimizer, num_microbatches=microbatches, remat=False
+    )
+    state = init_pp_train_state(cfg, mesh, jax.random.PRNGKey(0), optimizer)
+    params, _, loss = step(state.params, state.opt_state, batch)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+    merged = merge_layers_from_pp(params)
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_params)
+    flat_got = {jax.tree_util.keystr(k): v
+                for k, v in jax.tree_util.tree_leaves_with_path(merged)}
+    for key, ref_leaf in flat_ref:
+        got = flat_got[jax.tree_util.keystr(key)]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref_leaf), rtol=3e-4, atol=3e-5,
+            err_msg=f"param {jax.tree_util.keystr(key)} diverged under pp",
+        )
+
+
+def test_pp_with_remat_matches():
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+        rope_theta=10000.0, tie_word_embeddings=True,
+    )
+    optimizer = optax.sgd(1e-2)
+    batch = _batch(cfg, b=4, s=16, seed=1)
+    _, _, ref_loss = _ref_step(cfg, batch, optimizer)
+
+    mesh = make_mesh(MeshPlan(pp=2))
+    step, _ = make_pp_train_step(cfg, mesh, optimizer, num_microbatches=2, remat=True)
+    state = init_pp_train_state(cfg, mesh, jax.random.PRNGKey(0), optimizer)
+    _, _, loss = step(state.params, state.opt_state, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+
+
+def test_pp_composes_with_dp():
+    """pp=2 x dp=2: batch shards over dp inside each pipeline stage."""
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8,
+        rope_theta=10000.0, tie_word_embeddings=True,
+    )
+    optimizer = optax.sgd(1e-2)
+    batch = _batch(cfg, b=8, s=16, seed=2)
+    _, _, ref_loss = _ref_step(cfg, batch, optimizer)
+
+    mesh = make_mesh(MeshPlan(dp=2, pp=2))
+    step, _ = make_pp_train_step(cfg, mesh, optimizer, num_microbatches=2, remat=False)
+    state = init_pp_train_state(cfg, mesh, jax.random.PRNGKey(0), optimizer)
+    _, _, loss = step(state.params, state.opt_state, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+
+
+def test_split_merge_roundtrip():
+    cfg = Qwen2Config(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_layers=4, num_heads=2, num_kv_heads=2, head_dim=8,
+        rope_theta=10000.0, tie_word_embeddings=True,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    split = split_layers_for_pp(params, 2)
+    back = merge_layers_from_pp(split)
+    for (ka, a), (kb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(back),
+    ):
+        assert jax.tree_util.keystr(ka) == jax.tree_util.keystr(kb)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="divide"):
+        split_layers_for_pp(params, 3)
