@@ -133,6 +133,11 @@ class Settings:
     sp_prefill_threshold: int = field(
         default_factory=lambda: _env_int("SP_PREFILL_THRESHOLD", 0)
     )
+    # >0: n-gram speculative decoding with drafts of up to k tokens
+    # (serving/spec_decode.py) instead of pipelined decode bursts; a latency
+    # knob for quoting-heavy greedy decodes, 0 (bursts) is the throughput
+    # default
+    spec_ngram_k: int = field(default_factory=lambda: _env_int("SPEC_NGRAM_K", 0))
 
     @property
     def scope_tables(self) -> dict[str, str]:

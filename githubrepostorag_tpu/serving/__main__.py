@@ -97,6 +97,7 @@ async def serve(host: str, port: int) -> None:
         mesh=mesh,
         prefix_caching=s.prefix_caching,
         sp_prefill_threshold=s.sp_prefill_threshold or None,
+        spec_ngram_k=s.spec_ngram_k,
     )
     logger.info("precompiling engine programs (prefill buckets + decode burst)")
     engine.warmup()

@@ -141,4 +141,6 @@ class AsyncEngine:
                 "prefix_cache_hit_tokens": getattr(
                     self.engine._allocator, "hit_tokens", 0
                 ),
+                "spec_proposed": self.engine.spec_proposed,
+                "spec_accepted": self.engine.spec_accepted,
             }

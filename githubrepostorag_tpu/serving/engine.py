@@ -108,6 +108,8 @@ class Engine:
         prefix_caching: bool = True,  # vLLM automatic-prefix-caching analog
         sp_prefill_threshold: int | None = None,  # prompts this long prefill
         # sequence-parallel over the mesh's sp axis (serving/long_prefill.py)
+        spec_ngram_k: int = 0,  # >0: n-gram speculative decoding with drafts
+        # of up to k tokens (serving/spec_decode.py) instead of decode bursts
     ) -> None:
         self.mesh = mesh
         if mesh is not None:
@@ -156,6 +158,9 @@ class Engine:
         self.sp_prefill_threshold = sp_prefill_threshold
         self._sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         self.sp_prefills = 0  # stats: prompts served by the ring-prefill path
+        self.spec_ngram_k = spec_ngram_k
+        self.spec_proposed = 0  # stats: draft tokens offered / accepted
+        self.spec_accepted = 0
 
         # host-side batch state
         self._block_tables = np.zeros((max_num_seqs, self.max_pages_per_seq), dtype=np.int32)
@@ -275,7 +280,10 @@ class Engine:
 
         self._try_prefill(finished)
         if any(r.state == "running" for r in self._row_req.values()):
-            self._decode_step(finished)
+            if self.spec_ngram_k > 0:
+                self._spec_decode_step(finished)
+            else:
+                self._decode_step(finished)
         if not self._row_req:
             # nothing left running: land any in-flight burst (its tokens
             # belong to already-finished rows) and recycle deferred pages
@@ -515,9 +523,9 @@ class Engine:
         wave = [(reqs[i], i) for i in done_idx]
         for req, _ in wave:
             req.state = "running"
-        if self._chain is None and not others_running:
-            # engine was otherwise idle: nothing to overlap the sync with,
-            # so commit immediately (best TTFT)
+        if (self._chain is None and not others_running) or self.spec_ngram_k > 0:
+            # engine idle (nothing to overlap the sync with) or speculative
+            # mode (synchronous by design): commit immediately (best TTFT)
             tokens = np.asarray(tokens_d)
             for req, i in wave:
                 self._commit_token(req, int(tokens[i]), finished)
@@ -577,7 +585,7 @@ class Engine:
         others_running = any(
             r.state == "running" and r is not req for r in self._row_req.values()
         )
-        if self._chain is None and not others_running:
+        if (self._chain is None and not others_running) or self.spec_ngram_k > 0:
             self._commit_token(req, int(np.asarray(tokens_d)[0]), finished)
         else:
             self._pending_first.append((tokens_d, [(req, 0)]))
@@ -666,6 +674,112 @@ class Engine:
         }
         if prev is not None:
             self._commit_burst(prev, finished)
+
+    def _spec_decode_step(self, finished: list[GenerationResult]) -> None:
+        """One speculative iteration (serving/spec_decode.py): rows on plain
+        greedy (temperature 0, no repetition penalty) get an n-gram draft of
+        up to ``spec_ngram_k`` tokens; ONE paged forward over
+        [last_token, draft...] verifies every row, and each row commits its
+        longest model-agreed prefix plus the model's correction token — up
+        to k+1 tokens per dispatch.  Rows with sampling or penalties commit
+        exactly one token from the standard sampler (their drafts would
+        need evolving-presence rejection sampling for parity; not worth the
+        complexity), so token outputs are identical to the burst path for
+        EVERY config.  Synchronous by design — see the module docstring's
+        trade-off against pipelined bursts."""
+        from githubrepostorag_tpu.serving.spec_decode import ngram_propose
+
+        k = self.spec_ngram_k
+        width = k + 1
+        running = [r for r in self._row_req.values() if r.state == "running"]
+        rb = _bucket(len(running), self.max_num_seqs, minimum=1)
+        ids = np.zeros((rb, width), dtype=np.int32)
+        pos = np.zeros((rb, width), dtype=np.int32)
+        slots = np.full((rb, width), -1, dtype=np.int32)
+        bt = np.zeros((rb, self.max_pages_per_seq), dtype=np.int32)
+        cached = np.zeros((rb,), dtype=np.int32)
+        new_lens = np.zeros((rb,), dtype=np.int32)
+        drafts: list[list[int]] = []
+        plain_greedy: list[bool] = []
+        for i, req in enumerate(running):
+            sp = req.sampling
+            eligible = sp.temperature <= 0.0 and sp.repetition_penalty == 1.0
+            plain_greedy.append(eligible)
+            draft: list[int] = []
+            if eligible:
+                cap = min(
+                    k,
+                    int(self._row_limits[req.row]) - req.seq_len - 1,
+                    sp.max_tokens - len(req.output) - 1,
+                )
+                if cap > 0:
+                    draft = ngram_propose(req.prompt + req.output, cap)
+            drafts.append(draft)
+            self.spec_proposed += len(draft)
+            n_new = 1 + len(draft)
+            ids[i, 0] = req.output[-1] if req.output else req.prompt[-1]
+            ids[i, 1:n_new] = draft
+            pos[i] = np.arange(req.seq_len, req.seq_len + width)
+            slots[i] = slot_mapping(
+                self._block_tables[req.row], req.seq_len, n_new, self.page_size, width
+            )
+            bt[i] = self._block_tables[req.row]
+            cached[i] = req.seq_len
+            new_lens[i] = n_new
+
+        with annotate("engine.spec_decode"):
+            # full-width logits: [rb, k+1, V] — k is small, and verification
+            # needs every position
+            logits, self._k_pages, self._v_pages = forward_paged(
+                self.params, self.cfg,
+                jnp.asarray(ids), jnp.asarray(pos),
+                self._k_pages, self._v_pages,
+                jnp.asarray(slots), jnp.asarray(bt),
+                jnp.asarray(cached), jnp.asarray(new_lens),
+                use_pallas=self.use_pallas,
+            )
+
+        row_idx = np.zeros((rb,), dtype=np.int32)
+        row_idx[: len(running)] = [r.row for r in running]
+        row_d = jnp.asarray(row_idx)
+        greedy_toks = np.asarray(jnp.argmax(logits, axis=-1))  # [rb, width]
+        sampled0 = None
+        if not all(plain_greedy):
+            self._push_sampling()
+            self._rng, key = jax.random.split(self._rng)
+            sampled0 = np.asarray(sample_tokens(
+                logits[:, 0], key,
+                self._temp_d[row_d], self._top_p_d[row_d], self._top_k_d[row_d],
+                self._rep_pen_d[row_d], self._presence[row_d],
+            ))
+
+        # sentinel-padded committed-token matrix -> one batched presence mark
+        committed = np.full((rb, width), self.cfg.vocab_size, dtype=np.int32)
+        counts = np.zeros((rb,), dtype=np.int32)
+        for i, req in enumerate(running):
+            if plain_greedy[i]:
+                draft = drafts[i]
+                a = 0
+                while a < len(draft) and greedy_toks[i, a] == draft[a]:
+                    a += 1
+                toks = [int(t) for t in greedy_toks[i, : a + 1]]
+            else:
+                a = 0
+                toks = [int(sampled0[i])]
+            for j, t in enumerate(toks):
+                req.seq_len += 1
+                self._seq_lens[req.row] = req.seq_len
+                committed[i, counts[i]] = t
+                counts[i] += 1
+                if j < a:  # an accepted draft that actually committed
+                    self.spec_accepted += 1
+                self._commit_token(req, t, finished)
+                if req.state != "running":
+                    break
+        self._presence = _mark_presence_chunks(
+            self._presence, row_d, jnp.asarray(committed),
+            jnp.asarray(counts), self.cfg.vocab_size,
+        )
 
     def _commit_first_tokens(
         self,

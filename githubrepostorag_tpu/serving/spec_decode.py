@@ -1,0 +1,52 @@
+"""N-gram speculative decoding (vLLM's "prompt lookup decoding" rebuilt
+for this engine).
+
+RAG answers quote their context: file paths, identifiers, code spans from
+retrieved chunks reappear verbatim in the output.  When the last few
+generated tokens match an n-gram seen earlier in the row's prompt+output,
+the tokens that followed that earlier occurrence are a free draft — no
+draft model, no extra weights.  The engine then runs ONE paged forward
+over [last_token, draft...] (k+1 positions) and greedily accepts the
+longest prefix the model agrees with, committing up to k+1 tokens per
+dispatch instead of 1.
+
+Trade-off, stated plainly: every speculative step is a synchronous
+dispatch+fetch, so this mode forgoes the pipelined multi-step decode
+bursts (serving/decode_burst.py).  It wins when acceptance is high and
+per-dispatch overhead is low (local TPU, quoting-heavy decodes); bursts
+win for throughput under mixed traffic — which is why ``spec_ngram_k``
+defaults to 0 (off) and is a per-engine knob, not a global.
+
+Proposal search is host-side Python (it is control flow over small token
+lists — SURVEY.md §7's "scheduling stays off-device" rule), verification
+is one fixed-shape device program.
+"""
+
+from __future__ import annotations
+
+SEARCH_WINDOW = 4096  # only scan this many recent tokens for matches
+
+
+def ngram_propose(
+    tokens: list[int],
+    k: int,
+    *,
+    max_ngram: int = 4,
+    min_ngram: int = 1,
+) -> list[int]:
+    """Draft up to ``k`` tokens: find the most recent earlier occurrence of
+    the longest suffix n-gram (length max_ngram down to min_ngram) and
+    return the tokens that followed it.  Empty when nothing matches."""
+    if k <= 0 or len(tokens) < min_ngram + 1:
+        return []
+    window = tokens[-SEARCH_WINDOW:]
+    n_tok = len(window)
+    for n in range(min(max_ngram, n_tok - 1), min_ngram - 1, -1):
+        suffix = window[-n:]
+        # most recent earlier occurrence wins (locality: recent repetitions
+        # predict better than distant ones); start <= n_tok - n - 1 means at
+        # least one token always follows the match
+        for start in range(n_tok - n - 1, -1, -1):
+            if window[start : start + n] == suffix:
+                return window[start + n : start + n + k]
+    return []

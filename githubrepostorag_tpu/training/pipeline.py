@@ -182,6 +182,11 @@ def make_pp_train_step(
         b, S = batch["input_ids"].shape
         if b % M:
             raise ValueError(f"batch {b} must divide by num_microbatches {M}")
+        if (b // M) % dp:
+            raise ValueError(
+                f"microbatch size {b // M} (batch {b} / {M} microbatches) "
+                f"must divide by mesh dp={dp}"
+            )
         to_mb = lambda x: x.reshape(M, b // M, S)
         return shard_body(
             params["layers"],
