@@ -31,6 +31,25 @@ def _np(t) -> np.ndarray:
 
 def config_from_hf(hf_cfg: dict) -> Qwen2Config:
     num_heads = hf_cfg["num_attention_heads"]
+    moe: dict = {}
+    if hf_cfg.get("num_experts", 0):  # Qwen2MoeConfig (model_type qwen2_moe)
+        if hf_cfg.get("decoder_sparse_step", 1) != 1 or hf_cfg.get("mlp_only_layers"):
+            # lax.scan over stacked layers needs a uniform block structure
+            raise ValueError(
+                "only uniformly-sparse Qwen2-MoE checkpoints are supported "
+                "(decoder_sparse_step=1, no mlp_only_layers)"
+            )
+        moe = dict(
+            num_experts=hf_cfg["num_experts"],
+            num_experts_per_tok=hf_cfg["num_experts_per_tok"],
+            moe_intermediate_size=hf_cfg["moe_intermediate_size"],
+            shared_expert_intermediate_size=hf_cfg["shared_expert_intermediate_size"],
+            norm_topk_prob=hf_cfg.get("norm_topk_prob", False),
+            # serving default: bounded-capacity dispatch.  The exact no-drop
+            # mode (capacity_factor=0) builds [T, E, T] dispatch tensors —
+            # parity-test scale only; override via dataclasses.replace
+            capacity_factor=2.0,
+        )
     return Qwen2Config(
         vocab_size=hf_cfg["vocab_size"],
         hidden_size=hf_cfg["hidden_size"],
@@ -43,6 +62,7 @@ def config_from_hf(hf_cfg: dict) -> Qwen2Config:
         rms_norm_eps=hf_cfg.get("rms_norm_eps", 1e-6),
         tie_word_embeddings=hf_cfg.get("tie_word_embeddings", False),
         max_position_embeddings=hf_cfg.get("max_position_embeddings", 32768),
+        **moe,
     )
 
 
@@ -70,10 +90,33 @@ def params_from_state_dict(state_dict: dict, cfg: Qwen2Config, dtype=np.float32)
         "wv": stack_linear("layers.{}.self_attn.v_proj.weight"),
         "bv": stack_vec("layers.{}.self_attn.v_proj.bias"),
         "wo": stack_linear("layers.{}.self_attn.o_proj.weight"),
-        "wg": stack_linear("layers.{}.mlp.gate_proj.weight"),
-        "wu": stack_linear("layers.{}.mlp.up_proj.weight"),
-        "wd": stack_linear("layers.{}.mlp.down_proj.weight"),
     }
+    if cfg.num_experts > 0:  # Qwen2-MoE sparse MLP (models/moe.py keys)
+        E = cfg.num_experts
+
+        def stack_experts(fmt: str) -> np.ndarray:
+            # [L, E, in, out] from HF's per-expert [out, in] linears
+            return np.stack([
+                np.stack([get(fmt.format(i, e)).T for e in range(E)])
+                for i in range(L)
+            ]).astype(dtype)
+
+        layers.update({
+            "router": stack_linear("layers.{}.mlp.gate.weight"),
+            "e_wg": stack_experts("layers.{}.mlp.experts.{}.gate_proj.weight"),
+            "e_wu": stack_experts("layers.{}.mlp.experts.{}.up_proj.weight"),
+            "e_wd": stack_experts("layers.{}.mlp.experts.{}.down_proj.weight"),
+            "s_wg": stack_linear("layers.{}.mlp.shared_expert.gate_proj.weight"),
+            "s_wu": stack_linear("layers.{}.mlp.shared_expert.up_proj.weight"),
+            "s_wd": stack_linear("layers.{}.mlp.shared_expert.down_proj.weight"),
+            "s_gate": stack_linear("layers.{}.mlp.shared_expert_gate.weight"),
+        })
+    else:
+        layers.update({
+            "wg": stack_linear("layers.{}.mlp.gate_proj.weight"),
+            "wu": stack_linear("layers.{}.mlp.up_proj.weight"),
+            "wd": stack_linear("layers.{}.mlp.down_proj.weight"),
+        })
     params = {
         "embed": get("embed_tokens.weight").astype(dtype),
         "layers": layers,

@@ -1,0 +1,103 @@
+"""Sparse Mixture-of-Experts MLP (Qwen2-MoE family) with expert-parallel
+sharding over the ``ep`` mesh axis.
+
+The TPU formulation: no scatters, no per-expert Python — routing becomes
+dense one-hot dispatch/combine tensors and the expert FFN is ONE batched
+einsum over stacked expert weights [E, ...] (GShard/Switch style).  With
+the expert axis of the weights sharded P("ep", ...), GSPMD turns the
+dispatch/combine einsums into the all-to-alls of classic expert
+parallelism — no hand-written collectives, same recipe as the rest of the
+mesh fabric (SURVEY.md §2.3: the mesh was designed so EP "can slot in";
+this fills the slot).
+
+Math matches HF ``Qwen2MoeSparseMoeBlock`` (softmax router in float32,
+top-k, optional top-k renorm, plus an always-on shared expert scaled by a
+sigmoid gate), so HF-parity tests hold token-exact when capacity is
+no-drop.  Capacity: ``cfg.capacity_factor == 0`` gives exact no-drop
+dispatch (capacity = T; dispatch tensors are [T, E, T] — parity/test
+scale); real serving sets a factor so capacity = ceil(K*T/E * factor) and
+overflow tokens simply lose that expert's contribution (standard
+token-dropping semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_mlp(cfg, p: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Sparse MoE MLP over normed hidden states ``x`` [B, S, d].
+
+    ``p`` keys: ``router`` [d, E]; ``e_wg``/``e_wu`` [E, d, ff_e],
+    ``e_wd`` [E, ff_e, d]; ``s_wg``/``s_wu`` [d, ff_s], ``s_wd`` [ff_s, d];
+    ``s_gate`` [d, 1].
+    """
+    b, s, d = x.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    T = b * s
+    xf = x.reshape(T, d)
+
+    # --- router: float32 softmax over experts, top-k (HF parity) ----------
+    logits = jnp.einsum("td,de->te", xf.astype(jnp.float32), p["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    top_p, top_i = jax.lax.top_k(probs, K)  # [T, K]
+    if cfg.norm_topk_prob:
+        top_p = top_p / jnp.maximum(top_p.sum(axis=-1, keepdims=True), 1e-20)
+
+    # --- dispatch/combine tensors (one-hot + in-expert position) ----------
+    if cfg.capacity_factor > 0:
+        C = max(1, int(-(-K * T * cfg.capacity_factor // E)))
+    else:
+        C = T  # no-drop: an expert can at most receive every token once
+    oh = jax.nn.one_hot(top_i, E, dtype=jnp.float32)  # [T, K, E]
+    oh_flat = oh.reshape(T * K, E)
+    # arrival order: token-major, then k — position of each assignment in
+    # its expert's queue decides who fits under the capacity
+    pos = jnp.cumsum(oh_flat, axis=0) - oh_flat
+    slot = (pos * oh_flat).sum(-1)  # [T*K] this assignment's queue position
+    keep = slot < C
+    slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32) * keep[:, None]
+    dispatch = jnp.einsum("ae,ac->aec", oh_flat, slot_oh).reshape(T, K, E, C)
+    combine = (dispatch * top_p[..., None, None]).sum(1)  # [T, E, C]
+    dispatch = dispatch.sum(1)  # [T, E, C] 0/1
+
+    # --- expert FFN: one batched einsum per projection --------------------
+    cdt = x.dtype
+    xs = jnp.einsum("td,tec->ecd", xf, dispatch.astype(cdt))  # [E, C, d]
+    h1 = jnp.einsum("ecd,edf->ecf", xs, p["e_wg"])
+    h2 = jnp.einsum("ecd,edf->ecf", xs, p["e_wu"])
+    ys = jnp.einsum("ecf,efd->ecd", jax.nn.silu(h1) * h2, p["e_wd"])
+    y = jnp.einsum("ecd,tec->td", ys, combine.astype(cdt))
+
+    # --- always-on shared expert with sigmoid gate ------------------------
+    sh = jax.nn.silu(xf @ p["s_wg"]) * (xf @ p["s_wu"])
+    sh = (sh @ p["s_wd"]) * jax.nn.sigmoid(xf @ p["s_gate"])
+    return (y + sh).reshape(b, s, d)
+
+
+def init_moe_layer_params(cfg, key: jax.Array, dtype=jnp.float32) -> dict:
+    """Random init of ONE stack of MoE-MLP layer params ([L, ...] leaves),
+    merged into the attention params by qwen2.init_params."""
+    L, d = cfg.num_layers, cfg.hidden_size
+    E, ffe, ffs = cfg.num_experts, cfg.moe_intermediate_size, cfg.shared_expert_intermediate_size
+    ks = jax.random.split(key, 8)
+    norm = lambda k, *shape: (
+        jax.random.normal(k, shape, dtype=jnp.float32) * 0.02
+    ).astype(dtype)
+    return {
+        "router": norm(ks[0], L, d, E),
+        "e_wg": norm(ks[1], L, E, d, ffe),
+        "e_wu": norm(ks[2], L, E, d, ffe),
+        "e_wd": norm(ks[3], L, E, ffe, d),
+        "s_wg": norm(ks[4], L, d, ffs),
+        "s_wu": norm(ks[5], L, d, ffs),
+        "s_wd": norm(ks[6], L, ffs, d),
+        "s_gate": norm(ks[7], L, d, 1),
+    }
+
+
+# EP sharding lives with every other layout decision in
+# parallel/sharding.py::qwen2_param_specs (expert axes P(None, "ep", ...)),
+# so Engine(mesh=...) and init_train_state shard MoE trees the same way
+# they shard dense ones.

@@ -117,6 +117,12 @@ def quantize_qwen2_params(params: dict, embeddings: bool = True) -> dict:
     step for logits); norms and biases stay bf16."""
     out = dict(params)
     layers = dict(params["layers"])
+    if "router" in layers:
+        raise NotImplementedError(
+            "int8 weight-only quantization does not cover the MoE family yet "
+            "(expert tensors need per-expert scales); load MoE checkpoints "
+            "with quantize=False"
+        )
     for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
         layers[name] = quantize_weight(layers[name])
     out["layers"] = layers

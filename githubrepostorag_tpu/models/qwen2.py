@@ -44,6 +44,17 @@ class Qwen2Config:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = True
     max_position_embeddings: int = 32768
+    # ---- MoE (Qwen2-MoE family: Qwen1.5-MoE-A2.7B / Qwen2-57B-A14B) ------
+    # num_experts 0 = dense; >0 switches every layer's MLP to the sparse
+    # block (router top-k experts + always-on shared expert), models/moe.py
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    shared_expert_intermediate_size: int = 0
+    norm_topk_prob: bool = False
+    # expert capacity = ceil(K*T/E * factor); 0.0 = exact no-drop dispatch
+    # (capacity T — HF-parity math, quadratic dispatch tensors: test scale)
+    capacity_factor: float = 0.0
 
     # ---- presets (HF config.json values for the eval-config model family) --
 
@@ -61,6 +72,30 @@ class Qwen2Config:
             rope_theta=10_000.0,
             tie_word_embeddings=True,
             max_position_embeddings=512,
+        )
+
+    @classmethod
+    def tiny_moe(cls) -> "Qwen2Config":
+        """Test-scale MoE: 4 experts top-2 + shared expert."""
+        return cls(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            rope_theta=10_000.0, tie_word_embeddings=True,
+            max_position_embeddings=512,
+            num_experts=4, num_experts_per_tok=2, moe_intermediate_size=48,
+            shared_expert_intermediate_size=96, norm_topk_prob=True,
+        )
+
+    @classmethod
+    def qwen1_5_moe_a2_7b(cls) -> "Qwen2Config":
+        """Qwen/Qwen1.5-MoE-A2.7B geometry (60 experts top-4 + shared)."""
+        return cls(
+            vocab_size=151936, hidden_size=2048, intermediate_size=5632,
+            num_layers=24, num_heads=16, num_kv_heads=16, head_dim=128,
+            tie_word_embeddings=False,
+            num_experts=60, num_experts_per_tok=4, moe_intermediate_size=1408,
+            shared_expert_intermediate_size=5632, norm_topk_prob=False,
+            capacity_factor=2.0,
         )
 
     @classmethod
@@ -97,7 +132,7 @@ def init_params(cfg: Qwen2Config, key: jax.Array, dtype=jnp.float32) -> dict:
     def norm(key, *shape):
         return (jax.random.normal(key, shape, dtype=jnp.float32) * 0.02).astype(dtype)
 
-    keys = jax.random.split(k_layers, 9)
+    keys = jax.random.split(k_layers, 10)
     layers = {
         "ln1": jnp.ones((L, d), dtype=dtype),
         "ln2": jnp.ones((L, d), dtype=dtype),
@@ -108,10 +143,17 @@ def init_params(cfg: Qwen2Config, key: jax.Array, dtype=jnp.float32) -> dict:
         "wv": norm(keys[2], L, d, nkv * hd),
         "bv": jnp.zeros((L, nkv * hd), dtype=dtype),
         "wo": norm(keys[3], L, nq * hd, d),
-        "wg": norm(keys[4], L, d, inter),
-        "wu": norm(keys[5], L, d, inter),
-        "wd": norm(keys[6], L, inter, d),
     }
+    if cfg.num_experts > 0:
+        from githubrepostorag_tpu.models.moe import init_moe_layer_params
+
+        layers.update(init_moe_layer_params(cfg, keys[9], dtype=dtype))
+    else:
+        layers.update({
+            "wg": norm(keys[4], L, d, inter),
+            "wu": norm(keys[5], L, d, inter),
+            "wd": norm(keys[6], L, inter, d),
+        })
     params = {
         "embed": norm(k_embed, cfg.vocab_size, d),
         "layers": layers,
@@ -141,7 +183,12 @@ def _block(cfg: Qwen2Config, h, p, cos, sin, attend):
     h = h + qmatmul(attn.reshape(b, s, nq * hd), p["wo"])
 
     hn = rms_norm(h, p["ln2"], cfg.rms_norm_eps)
-    h = h + qmatmul(jax.nn.silu(qmatmul(hn, p["wg"])) * qmatmul(hn, p["wu"]), p["wd"])
+    if "router" in p:  # sparse MoE MLP (Qwen2-MoE family, models/moe.py)
+        from githubrepostorag_tpu.models.moe import moe_mlp
+
+        h = h + moe_mlp(cfg, p, hn)
+    else:
+        h = h + qmatmul(jax.nn.silu(qmatmul(hn, p["wg"])) * qmatmul(hn, p["wu"]), p["wd"])
     return h, cache_info
 
 

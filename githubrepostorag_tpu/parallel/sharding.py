@@ -62,10 +62,28 @@ def qwen2_param_specs(cfg: Qwen2Config, mesh: Mesh, params: dict | None = None) 
         "wv": P(None, None, kv_tp),
         "bv": P(None, kv_tp),
         "wo": P(None, q_tp, None),
-        "wg": P(None, None, mlp_tp),
-        "wu": P(None, None, mlp_tp),
-        "wd": P(None, mlp_tp, None),
     }
+    if cfg.num_experts > 0:
+        # MoE MLP: expert axis over ep (models/moe.py — GSPMD turns the
+        # dispatch/combine einsums into expert-parallel all-to-alls);
+        # router/shared-expert replicated
+        ep = _axis(mesh, "ep", cfg.num_experts)
+        layers.update({
+            "router": P(None, None, None),
+            "e_wg": P(None, ep, None, None),
+            "e_wu": P(None, ep, None, None),
+            "e_wd": P(None, ep, None, None),
+            "s_wg": P(None, None, None),
+            "s_wu": P(None, None, None),
+            "s_wd": P(None, None, None),
+            "s_gate": P(None, None, None),
+        })
+    else:
+        layers.update({
+            "wg": P(None, None, mlp_tp),
+            "wu": P(None, None, mlp_tp),
+            "wd": P(None, mlp_tp, None),
+        })
     specs = {
         "embed": P(vocab_tp, None),
         "layers": layers,
