@@ -57,10 +57,11 @@ def moe_mlp(cfg, p: dict, x: jnp.ndarray) -> jnp.ndarray:
     pos = jnp.cumsum(oh_flat, axis=0) - oh_flat
     slot = (pos * oh_flat).sum(-1)  # [T*K] this assignment's queue position
     keep = slot < C
-    slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32) * keep[:, None]
-    dispatch = jnp.einsum("ae,ac->aec", oh_flat, slot_oh).reshape(T, K, E, C)
-    combine = (dispatch * top_p[..., None, None]).sum(1)  # [T, E, C]
-    dispatch = dispatch.sum(1)  # [T, E, C] 0/1
+    slot_oh = (jax.nn.one_hot(slot, C, dtype=jnp.float32) * keep[:, None]).reshape(T, K, C)
+    # contract k inside the einsums: a materialized [T, K, E, C] would be
+    # K times the memory of the [T, E, C] tensors actually needed
+    dispatch = jnp.einsum("tke,tkc->tec", oh, slot_oh)  # [T, E, C] 0/1
+    combine = jnp.einsum("tke,tkc,tk->tec", oh, slot_oh, top_p)
 
     # --- expert FFN: one batched einsum per projection --------------------
     cdt = x.dtype

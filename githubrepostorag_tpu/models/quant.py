@@ -141,6 +141,11 @@ def init_params_quantized(cfg, seed: int = 0) -> dict:
     import ml_dtypes
     import numpy as np
 
+    if getattr(cfg, "num_experts", 0):
+        raise NotImplementedError(
+            "int8 weight-only quantization does not cover the MoE family yet; "
+            "a MoE config here would silently build (and measure) a dense tree"
+        )
     rng = np.random.default_rng(seed)
     d, nq, nkv, hd, inter, L, v = (
         cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
