@@ -244,12 +244,19 @@ def _main() -> None:
                                         max_seq=1024)
         emit("decode_tok_s_per_chip_qwen2-0.5b_bs8", tps, "tok/s", tps / BASELINE_TOK_S)
 
-        # ---- eval config #2 geometry (1.5B, bs=8) ------------------------
+        # ---- eval config #2 geometry (1.5B, bs=8 and bs=32) --------------
         cfg15 = Qwen2Config.qwen2_1_5b()
-        tps15, _, _ = bench_decode(cfg15, "qwen2-1.5b", batch=8, prompt_len=128,
-                                   gen_tokens=256, num_pages=64, page_size=256,
-                                   max_seq=1024, runs=2)
+        tps15, _, params15 = bench_decode(cfg15, "qwen2-1.5b", batch=8, prompt_len=128,
+                                          gen_tokens=256, num_pages=64, page_size=256,
+                                          max_seq=1024, runs=2)
         emit("decode_tok_s_per_chip_qwen2-1.5b_bs8", tps15, "tok/s", tps15 / BASELINE_TOK_S)
+        # decode is weight-read bound: bs=32 measures ~2.6x bs=8 on one chip
+        tps15b, _, _ = bench_decode(cfg15, "qwen2-1.5b-bs32", batch=32,
+                                    prompt_len=128, gen_tokens=128,
+                                    num_pages=160, page_size=256, max_seq=1024,
+                                    runs=2, params=params15, decode_burst=32)
+        emit("decode_tok_s_per_chip_qwen2-1.5b_bs32", tps15b, "tok/s",
+             tps15b / BASELINE_TOK_S)
 
         # ---- eval configs #5 + #4 share one 64-seq engine ----------------
         eng = Engine(params05, cfg05, max_num_seqs=64, num_pages=320, page_size=64,

@@ -48,6 +48,19 @@ TTFT = Histogram(
 DECODE_TOKENS = Counter("rag_decode_tokens_total", "Generated tokens", registry=REGISTRY)
 ENGINE_RUNNING = Gauge("rag_engine_running_seqs", "Sequences in the decode batch", registry=REGISTRY)
 ENGINE_WAITING = Gauge("rag_engine_waiting_seqs", "Queued requests", registry=REGISTRY)
+PREFIX_CACHE_HITS = Counter(
+    "rag_prefix_cache_hit_tokens_total",
+    "Prompt tokens served from the KV prefix cache instead of prefill",
+    registry=REGISTRY,
+)
+SPEC_PROPOSED = Counter(
+    "rag_spec_draft_tokens_total", "Speculative draft tokens proposed", registry=REGISTRY
+)
+SPEC_ACCEPTED = Counter(
+    "rag_spec_accepted_tokens_total",
+    "Speculative draft tokens the model accepted and committed",
+    registry=REGISTRY,
+)
 
 
 def render() -> bytes:

@@ -61,8 +61,22 @@ class AsyncEngine:
             DECODE_TOKENS,
             ENGINE_RUNNING,
             ENGINE_WAITING,
+            PREFIX_CACHE_HITS,
+            SPEC_ACCEPTED,
+            SPEC_PROPOSED,
             TTFT,
         )
+
+        # engine stats are cumulative ints; export deltas to the counters
+        last = {"hit": 0, "prop": 0, "acc": 0}
+
+        def export_counters() -> None:
+            hit = getattr(self.engine._allocator, "hit_tokens", 0)
+            PREFIX_CACHE_HITS.inc(hit - last["hit"])
+            SPEC_PROPOSED.inc(self.engine.spec_proposed - last["prop"])
+            SPEC_ACCEPTED.inc(self.engine.spec_accepted - last["acc"])
+            last.update(hit=hit, prop=self.engine.spec_proposed,
+                        acc=self.engine.spec_accepted)
 
         while not self._stop:
             with self._lock:
@@ -70,6 +84,7 @@ class AsyncEngine:
                 finished = self.engine.step() if has_work else []
                 ENGINE_RUNNING.set(self.engine.num_running)
                 ENGINE_WAITING.set(self.engine.num_waiting)
+                export_counters()
             for res in finished:
                 DECODE_TOKENS.inc(len(res.output_tokens))
                 if res.ttft_s is not None:

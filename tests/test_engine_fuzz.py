@@ -53,7 +53,10 @@ CONFIGS = [
 @pytest.mark.parametrize("extra", CONFIGS, ids=["default", "nocache", "spec", "burst1"])
 def test_random_schedule_episode(tiny, extra):
     params, cfg = tiny
-    rng = np.random.default_rng(hash(str(sorted(extra.items()))) % 2**32)
+    import zlib
+
+    # deterministic per-config seed: a failing episode must replay exactly
+    rng = np.random.default_rng(zlib.crc32(repr(sorted(extra.items())).encode()))
 
     def make():
         return Engine(params, cfg, max_num_seqs=4, num_pages=48, page_size=8,
@@ -71,7 +74,7 @@ def test_random_schedule_episode(tiny, extra):
         [7, 8, 9, 10] * 7,  # loops: speculative-friendly
         rng.integers(0, cfg.vocab_size, 5).tolist(),
     ]
-    solo_cache: dict[int, list[int]] = {}
+    solo_cache: dict[tuple[int, int], list[int]] = {}
 
     def solo(pi: int, max_tokens: int) -> list[int]:
         key = (pi, max_tokens)
@@ -123,4 +126,44 @@ def test_random_schedule_episode(tiny, extra):
     # nothing leaked: allocator balanced, no stranded state
     assert eng._allocator.free_count == eng._allocator.num_pages
     assert not eng._row_req and not eng._waiting
+    assert eng._chain is None and not eng._pending_first and not eng._deferred
+
+
+def test_random_schedule_sampled_invariants(tiny):
+    """Sampled traffic (temperature > 0, top-p, penalties) under random
+    scheduling: outputs are seed-dependent, so only the structural
+    invariants are asserted — everything finishes, lengths are sane, and
+    nothing leaks."""
+    params, cfg = tiny
+    rng = np.random.default_rng(99)
+    eng = Engine(params, cfg, max_num_seqs=4, num_pages=48, page_size=8,
+                 max_seq_len=128, prefill_chunk=16, kv_dtype=jnp.float32,
+                 decode_burst=4, spec_ngram_k=3)
+    want: dict[str, int] = {}
+    done: dict[str, object] = {}
+    steps = 0
+    while steps < 400 and (eng.has_work() or len(want) < 12):
+        if len(want) < 12 and (rng.random() < 0.4 or not eng.has_work()):
+            mt = int(rng.integers(3, 12))
+            rid = eng.add_request(
+                rng.integers(0, cfg.vocab_size, int(rng.integers(4, 40))).tolist(),
+                SamplingParams(
+                    max_tokens=mt,
+                    temperature=float(rng.choice([0.0, 0.7, 1.1])),
+                    top_p=float(rng.choice([0.8, 0.95, 1.0])),
+                    repetition_penalty=float(rng.choice([1.0, 1.2])),
+                    stop_token_ids=(),
+                ),
+            )
+            want[rid] = mt
+        for res in eng.step():
+            done[res.request_id] = res
+        steps += 1
+    assert not eng.has_work()
+    for rid, mt in want.items():
+        res = done[rid]
+        assert res.finish_reason == "length"
+        assert len(res.output_tokens) == mt
+        assert all(0 <= t < cfg.vocab_size for t in res.output_tokens)
+    assert eng._allocator.free_count == eng._allocator.num_pages
     assert eng._chain is None and not eng._pending_first and not eng._deferred

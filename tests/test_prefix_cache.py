@@ -226,6 +226,28 @@ def test_cache_survives_page_pressure_and_accounting_balances(tiny):
     assert not eng.has_work()
 
 
+async def test_prefix_hits_reach_prometheus(tiny):
+    """The async driver exports the cumulative cache-hit stat as a counter
+    on /metrics (observability parity: SURVEY.md §5.5)."""
+    from githubrepostorag_tpu.metrics import render
+    from githubrepostorag_tpu.serving.async_engine import AsyncEngine
+
+    _, params, cfg = tiny
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, cfg.vocab_size, 40).tolist()
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    aeng = AsyncEngine(_engine(params, cfg))
+    await aeng.generate(prompt, sp)
+    await aeng.generate(prompt, sp)  # repeat: 32 tokens from the cache
+    await aeng.stop()
+    text = render().decode()
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("rag_prefix_cache_hit_tokens_total")
+    )
+    assert float(line.split()[-1]) >= 32.0, line
+
+
 def test_cached_prefix_skips_prefill_compute(tiny):
     """The repeat run must dispatch fewer prefill chunks: its prefill starts
     at the cached boundary."""
