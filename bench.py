@@ -289,6 +289,14 @@ def _main() -> None:
         # AWQ-equivalent path the reference itself deploys — values.yaml:67.
         # LAST metric: its ~13 min XLA compile must not cost the others.)
         if os.environ.get("BENCH_7B", "1") != "0":
+            # the 7B needs ~10 GB (int8 weights + pools): release every
+            # earlier model's params/engines first or device HBM still
+            # holds the 0.5B engine and the 3.1 GB 1.5B tree (observed
+            # RESOURCE_EXHAUSTED without this)
+            import gc
+
+            del eng, params05, params15
+            gc.collect()
             tps7 = bench_7b_int8()
             emit("decode_tok_s_per_chip_qwen2-7b_int8_bs32", tps7, "tok/s",
                  tps7 / BASELINE_TOK_S)

@@ -25,6 +25,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from githubrepostorag_tpu.models.quant import dequant_weight, qmatmul
+
 
 def moe_mlp(cfg, p: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Sparse MoE MLP over normed hidden states ``x`` [B, S, d].
@@ -66,14 +68,16 @@ def moe_mlp(cfg, p: dict, x: jnp.ndarray) -> jnp.ndarray:
     # --- expert FFN: one batched einsum per projection --------------------
     cdt = x.dtype
     xs = jnp.einsum("td,tec->ecd", xf, dispatch.astype(cdt))  # [E, C, d]
-    h1 = jnp.einsum("ecd,edf->ecf", xs, p["e_wg"])
-    h2 = jnp.einsum("ecd,edf->ecf", xs, p["e_wu"])
-    ys = jnp.einsum("ecf,efd->ecd", jax.nn.silu(h1) * h2, p["e_wd"])
+    h1 = jnp.einsum("ecd,edf->ecf", xs, dequant_weight(p["e_wg"], cdt))
+    h2 = jnp.einsum("ecd,edf->ecf", xs, dequant_weight(p["e_wu"], cdt))
+    ys = jnp.einsum(
+        "ecf,efd->ecd", jax.nn.silu(h1) * h2, dequant_weight(p["e_wd"], cdt)
+    )
     y = jnp.einsum("ecd,tec->td", ys, combine.astype(cdt))
 
     # --- always-on shared expert with sigmoid gate ------------------------
-    sh = jax.nn.silu(xf @ p["s_wg"]) * (xf @ p["s_wu"])
-    sh = (sh @ p["s_wd"]) * jax.nn.sigmoid(xf @ p["s_gate"])
+    sh = jax.nn.silu(qmatmul(xf, p["s_wg"])) * qmatmul(xf, p["s_wu"])
+    sh = qmatmul(sh, p["s_wd"]) * jax.nn.sigmoid(qmatmul(xf, p["s_gate"]))
     return (y + sh).reshape(b, s, d)
 
 

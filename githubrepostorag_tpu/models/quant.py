@@ -75,23 +75,30 @@ def quantize_weight(w) -> QuantizedLinear:
     return QuantizedLinear(q=q, s=s)
 
 
+def dequant_weight(w, dtype) -> jnp.ndarray:
+    """Compute-dtype view of a maybe-quantized linear weight.  THE one
+    definition of the int8->dtype expression (per-output-channel scales) —
+    every consumer (qmatmul, the MoE expert einsums, dequantize) routes
+    through here so a scheme change cannot silently miss a path.  XLA
+    fuses the convert+scale into the consuming dot's operand stream on
+    TPU; no bf16 copy is materialized for the common shapes."""
+    if isinstance(w, QuantizedLinear):
+        return w.q.astype(dtype) * w.s.astype(dtype)[..., None, :]
+    return w
+
+
 def dequantize(t: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
-    return (t.q.astype(jnp.float32) * t.s[..., None, :].astype(jnp.float32)).astype(dtype)
+    return dequant_weight(
+        QuantizedLinear(q=t.q, s=t.s.astype(jnp.float32)), jnp.float32
+    ).astype(dtype)
 
 
 def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
-    """``x @ w`` where ``w`` is a plain array or a QuantizedLinear.
-
-    Int8 path: contract x against the int8 weights with int32->f32
-    accumulation is not supported for mixed bf16/int8 operands on all
-    backends, so the weight is converted to the compute dtype at use; XLA
-    fuses the convert+scale into the dot's operand stream on TPU rather
-    than materializing a full bf16 copy in HBM for the common shapes.
-    """
-    if isinstance(w, QuantizedLinear):
-        wd = w.q.astype(x.dtype) * w.s.astype(x.dtype)[..., None, :]
-        return x @ wd
-    return x @ w
+    """``x @ w`` where ``w`` is a plain array or a QuantizedLinear (int8
+    contraction with int32 accumulation is not supported for mixed
+    bf16/int8 operands on all backends, so the weight dequantizes at use —
+    see dequant_weight)."""
+    return x @ dequant_weight(w, x.dtype)
 
 
 def quantize_embedding(w) -> QuantizedEmbedding:
@@ -111,19 +118,23 @@ def embedding_lookup(embed, ids: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray
 
 
 def quantize_qwen2_params(params: dict, embeddings: bool = True) -> dict:
-    """Quantize every linear projection of a Qwen2 param tree (layers
-    wq/wk/wv/wo/wg/wu/wd, lm_head when present, and — by default — the
-    embedding table, which a tied-weight model reads IN FULL every decode
-    step for logits); norms and biases stay bf16."""
+    """Quantize every linear projection of a Qwen2(-MoE) param tree
+    (attention wq/wk/wv/wo, the dense MLP or the expert+shared-expert
+    stacks, lm_head when present, and — by default — the embedding table,
+    which a tied-weight model reads IN FULL every decode step for logits);
+    norms, biases, the MoE router, and the shared-expert gate stay bf16."""
     out = dict(params)
     layers = dict(params["layers"])
     if "router" in layers:
-        raise NotImplementedError(
-            "int8 weight-only quantization does not cover the MoE family yet "
-            "(expert tensors need per-expert scales); load MoE checkpoints "
-            "with quantize=False"
-        )
-    for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
+        # MoE: experts + shared expert quantize with stacked per-expert
+        # scales ([L, E, ff] — _quantize_symmetric reduces axis -2 whatever
+        # the leading dims).  The router and the [d, 1] shared gate stay
+        # full precision: they are tiny and routing decisions are the
+        # precision-sensitive part of a sparse model.
+        mlp_names = ("e_wg", "e_wu", "e_wd", "s_wg", "s_wu", "s_wd")
+    else:
+        mlp_names = ("wg", "wu", "wd")
+    for name in ("wq", "wk", "wv", "wo") + mlp_names:
         layers[name] = quantize_weight(layers[name])
     out["layers"] = layers
     if "lm_head" in params:
@@ -143,8 +154,9 @@ def init_params_quantized(cfg, seed: int = 0) -> dict:
 
     if getattr(cfg, "num_experts", 0):
         raise NotImplementedError(
-            "int8 weight-only quantization does not cover the MoE family yet; "
-            "a MoE config here would silently build (and measure) a dense tree"
+            "random int8 MoE init is not implemented (this helper exists for "
+            "dense-geometry benches); real MoE checkpoints quantize through "
+            "load_qwen2(..., quantize=True)"
         )
     rng = np.random.default_rng(seed)
     d, nq, nkv, hd, inter, L, v = (

@@ -39,12 +39,17 @@ class AsyncEngine:
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._queues: dict[str, asyncio.Queue[StreamEvent]] = {}
+        # last engine-counter values already exported to prometheus —
+        # instance state, so a stop()/start() relaunch doesn't re-export
+        # the full cumulative totals
+        self._exported = {"hit": 0, "prop": 0, "acc": 0}
 
     # ------------------------------------------------------------ lifecycle
 
     async def start(self) -> None:
         if self._thread is not None:
             return
+        self._stop = False  # allow stop() -> start() relaunch
         self._loop = asyncio.get_running_loop()
         self._thread = threading.Thread(target=self._drive, name="engine-driver", daemon=True)
         self._thread.start()
@@ -68,7 +73,7 @@ class AsyncEngine:
         )
 
         # engine stats are cumulative ints; export deltas to the counters
-        last = {"hit": 0, "prop": 0, "acc": 0}
+        last = self._exported
 
         def export_counters() -> None:
             hit = getattr(self.engine._allocator, "hit_tokens", 0)

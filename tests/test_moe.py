@@ -158,12 +158,51 @@ def test_capacity_drops_are_bounded_not_catastrophic():
     assert frac_same > 0.5, f"only {frac_same:.0%} of logits survived capacity"
 
 
-def test_moe_quantize_rejected_cleanly(tiny_moe):
-    from githubrepostorag_tpu.models.quant import quantize_qwen2_params
+def test_moe_int8_quantization(tiny_moe):
+    """Weight-only int8 MoE: experts/shared-expert carry stacked per-expert
+    scales, router and gate stay full precision, and logits track the bf16
+    model within quantization tolerance (greedy engine output included)."""
+    from githubrepostorag_tpu.models.quant import (
+        QuantizedLinear,
+        quantize_qwen2_params,
+    )
 
-    _, params, _ = tiny_moe
-    with pytest.raises(NotImplementedError, match="MoE"):
-        quantize_qwen2_params(params)
+    _, params, cfg = tiny_moe
+    qp = quantize_qwen2_params(params)
+    layers = qp["layers"]
+    assert isinstance(layers["e_wg"], QuantizedLinear)
+    assert layers["e_wg"].q.dtype == jnp.int8
+    # scales: [L, E, ff] — per expert, per output channel
+    assert layers["e_wg"].s.shape == layers["e_wg"].q.shape[:2] + (
+        layers["e_wg"].q.shape[-1],
+    )
+    assert not isinstance(layers["router"], QuantizedLinear)
+    assert not isinstance(layers["s_gate"], QuantizedLinear)
+
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 16), dtype=np.int32))
+    pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (1, 16))
+    full = np.asarray(forward_with_attend(params, cfg, ids, pos))
+    quant = np.asarray(forward_with_attend(qp, cfg, ids, pos))
+    # int8 error bound, not exactness — relative to the logit scale
+    assert np.abs(quant - full).max() / (np.abs(full).max() + 1e-6) < 0.15
+
+    prompt = rng.integers(0, cfg.vocab_size, 15).tolist()
+    eng = Engine(qp, cfg, max_num_seqs=2, num_pages=32, page_size=8,
+                 max_seq_len=64, prefill_chunk=32, kv_dtype=jnp.float32,
+                 decode_burst=4)
+    res = eng.generate(
+        [prompt], SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+    )[0]
+    assert len(res.output_tokens) == 8
+
+
+def test_moe_random_int8_init_still_guarded(tiny_moe):
+    from githubrepostorag_tpu.models.quant import init_params_quantized
+
+    _, _, cfg = tiny_moe
+    with pytest.raises(NotImplementedError, match="load_qwen2"):
+        init_params_quantized(cfg)
 
 
 def test_moe_sharded_train_step(tiny_moe):
