@@ -191,3 +191,40 @@ def test_fake_llm_scripting():
     # selector prompts go through the choice cascade
     assert llm.complete("Pick one. respond with only the number") == "3"
     assert llm.calls[0]["prompt"].startswith("Please plan")
+
+
+async def test_multi_turn_chat_reuses_prefix_cache():
+    """Turn 2 of a conversation carries turn 1's rendered history verbatim,
+    so its prefill resumes from turn 1's cached KV pages — the RAG/chat
+    cost model the prefix cache exists for, proven at the API layer."""
+    server = _build_server()
+
+    async def body(session, base):
+        history = [{"role": "user", "content": "tell me about pages " * 4}]
+        r1 = await session.post(f"{base}/v1/chat/completions", json={
+            "messages": history, "max_tokens": 8, "temperature": 0,
+        })
+        assert r1.status == 200
+        reply = (await r1.json())["choices"][0]["message"]["content"]
+        hits_before = server.engine.engine._allocator.hit_tokens
+        history += [
+            {"role": "assistant", "content": reply},
+            {"role": "user", "content": "go on"},
+        ]
+        r2 = await session.post(f"{base}/v1/chat/completions", json={
+            "messages": history, "max_tokens": 8, "temperature": 0,
+        })
+        assert r2.status == 200
+        hits = server.engine.engine._allocator.hit_tokens - hits_before
+        # turn 1's prompt renders to 98 byte-tokens -> its 12 full 8-token
+        # pages come back from the cache on turn 2
+        assert hits >= 96, f"only {hits} tokens reused across turns"
+
+    import aiohttp
+
+    port = await server.start(host="127.0.0.1", port=0)
+    try:
+        async with aiohttp.ClientSession() as session:
+            await body(session, f"http://127.0.0.1:{port}")
+    finally:
+        await server.stop()
