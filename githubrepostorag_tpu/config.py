@@ -26,6 +26,22 @@ def _env_bool(name: str, default: bool = False) -> bool:
     return str(val).strip().lower() in {"1", "true", "t", "yes", "y", "on"}
 
 
+def _parse_quant_bits() -> int:
+    """QUANTIZE_WEIGHTS -> bit width (0 = off).  Raises on typos rather
+    than silently loading full-precision weights."""
+    raw = os.environ.get("QUANTIZE_WEIGHTS", "")
+    val = str(raw).strip().lower()
+    if val in {"", "0", "false", "f", "no", "n", "off"}:
+        return 0
+    if val in {"1", "true", "t", "yes", "y", "on", "int8", "8"}:
+        return 8
+    if val in {"int4", "4", "awq"}:
+        return 4
+    raise ValueError(
+        f"QUANTIZE_WEIGHTS={raw!r} not understood; use int4, int8, or a boolean"
+    )
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
@@ -88,13 +104,13 @@ class Settings:
     context_window: int = field(default_factory=lambda: _env_int("CONTEXT_WINDOW", 11712))
     llm_backend: str = field(default_factory=lambda: os.getenv("LLM_BACKEND", "inprocess"))  # inprocess|http|fake
     model_weights_path: str = field(default_factory=lambda: os.getenv("MODEL_WEIGHTS_PATH", ""))
-    # int8 weight-only quantization at load (fits 7B on one 16 GB chip; the
-    # AWQ-equivalent of the reference's vLLM deployment, values.yaml:67).
-    # QUANTIZE_WEIGHTS=int8 also accepted alongside the usual booleans.
-    quantize_weights: bool = field(
-        default_factory=lambda: _env_bool("QUANTIZE_WEIGHTS", False)
-        or os.getenv("QUANTIZE_WEIGHTS", "").strip().lower() == "int8"
-    )
+    # Weight-only quantization at load (fits 7B on one 16 GB chip; the
+    # reference deploys 4-bit AWQ, values.yaml:67).  QUANTIZE_WEIGHTS
+    # accepts int4 / int8 / the usual booleans (true -> int8); value is the
+    # bit width (0 = off) and stays truthy/falsy for boolean callers.
+    # Unrecognized values raise: a typo silently loading a 7B as bf16
+    # would OOM the chip with no hint the env var was ignored.
+    quantize_weights: int = field(default_factory=lambda: _parse_quant_bits())
 
     # --- Worker ---
     default_namespace: str = field(default_factory=lambda: os.getenv("DEFAULT_NAMESPACE", "default"))

@@ -48,6 +48,36 @@ class QuantizedEmbedding(NamedTuple):
     s: jnp.ndarray
 
 
+class QuantizedLinear4(NamedTuple):
+    """Weight-only 4-bit projection (the AWQ-class scheme the reference
+    actually deploys — vLLM serves Qwen2.5-Coder-7B-Instruct-AWQ,
+    /root/reference/helm/values.yaml:67).  Group-wise ASYMMETRIC uint4:
+
+        w[i, o] ≈ q[i, o] * s[g(i), o] - zs[g(i), o]
+
+    with g(i) = i // group_size over the INPUT axis — matching AWQ's
+    group-128/64 geometry (scales+zeros per input group per output channel).
+
+    ``q`` packs two nibbles per byte plane-wise WITHIN each group: for
+    group g of size gsz, byte row j holds original rows (g*gsz + j) in the
+    low nibble and (g*gsz + j + gsz/2) in the high nibble.  Unpacking is
+    two shifts + one concat on the in-group axis — no interleave/transpose
+    — so XLA fuses the dequant into the consuming dot's operand stream
+    like the int8 path.  Packing within groups (not across the whole input
+    axis) keeps row-parallel TP shards self-contained: any shard boundary
+    that lands on a group boundary owns whole groups of bytes AND their
+    scales, so GSPMD never has to redistribute the dequantized weight.
+
+    Fields: ``q`` uint8 [.., in/2, out]; ``s`` bf16 [.., in/group, out];
+    ``zs`` bf16 [.., in/group, out] with dequant ``w = q*s - zs``
+    (zs = -group_min; storing the product form makes dequant a fused
+    multiply-subtract)."""
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+    zs: jnp.ndarray
+
+
 def _quantize_symmetric(w, axis: int):
     """Shared symmetric-int8 recipe: reduce |w| over ``axis``, scale to
     127, round/clip, bf16 scales with the reduced axis squeezed out.
@@ -77,20 +107,77 @@ def quantize_weight(w) -> QuantizedLinear:
 
 def dequant_weight(w, dtype) -> jnp.ndarray:
     """Compute-dtype view of a maybe-quantized linear weight.  THE one
-    definition of the int8->dtype expression (per-output-channel scales) —
-    every consumer (qmatmul, the MoE expert einsums, dequantize) routes
-    through here so a scheme change cannot silently miss a path.  XLA
-    fuses the convert+scale into the consuming dot's operand stream on
-    TPU; no bf16 copy is materialized for the common shapes."""
+    definition of the int8/int4->dtype expression — every consumer
+    (qmatmul, the MoE expert einsums, dequantize) routes through here so a
+    scheme change cannot silently miss a path.  XLA fuses the
+    convert+scale into the consuming dot's operand stream on TPU; no bf16
+    copy is materialized for the common shapes."""
     if isinstance(w, QuantizedLinear):
         return w.q.astype(dtype) * w.s.astype(dtype)[..., None, :]
+    if isinstance(w, QuantizedLinear4):
+        lead, out = w.q.shape[:-2], w.q.shape[-1]
+        n_g = w.s.shape[-2]
+        in_half = w.q.shape[-2]  # in/2 packed byte rows
+        half_g = in_half // n_g  # gsz/2 byte rows per group
+        pg = w.q.reshape(*lead, n_g, half_g, out)
+        lo = (pg & jnp.uint8(0xF)).astype(dtype)
+        hi = (pg >> jnp.uint8(4)).astype(dtype)
+        grouped = jnp.concatenate([lo, hi], axis=-2)  # [.., n_g, gsz, out]
+        wf = (
+            grouped * w.s[..., :, None, :].astype(dtype)
+            - w.zs[..., :, None, :].astype(dtype)
+        )
+        return wf.reshape(*lead, 2 * in_half, out)
     return w
 
 
-def dequantize(t: QuantizedLinear, dtype=jnp.bfloat16) -> jnp.ndarray:
+def dequantize(t, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Full-precision reconstruction (f32 math, then cast) for tests."""
+    if isinstance(t, QuantizedLinear4):
+        return dequant_weight(
+            QuantizedLinear4(
+                q=t.q, s=t.s.astype(jnp.float32), zs=t.zs.astype(jnp.float32)
+            ),
+            jnp.float32,
+        ).astype(dtype)
     return dequant_weight(
         QuantizedLinear(q=t.q, s=t.s.astype(jnp.float32)), jnp.float32
     ).astype(dtype)
+
+
+def quantize_weight4(w, group_size: int = 64) -> QuantizedLinear4:
+    """Group-wise asymmetric uint4 (AWQ-class).  ``w`` is [in, out] or
+    stacked [.., in, out]; groups of ``group_size`` run along the input
+    axis.  64 (not AWQ's usual 128) is the default because every Qwen2
+    in-dimension splits into 64-token groups that stay whole under tp<=8
+    row-parallel sharding.  Host-side numpy like _quantize_symmetric (a 7B
+    tree must never materialize in f32 on the device being quantized for)."""
+    import ml_dtypes
+    import numpy as np
+
+    w_np = np.asarray(w, dtype=np.float32)
+    in_dim, out = w_np.shape[-2], w_np.shape[-1]
+    if group_size % 2 or in_dim % group_size:
+        raise ValueError(
+            f"input dim {in_dim} must be divisible by the (even) group_size "
+            f"{group_size} for in-group nibble plane packing"
+        )
+    lead = w_np.shape[:-2]
+    n_g, half = in_dim // group_size, group_size // 2
+    grouped = w_np.reshape(*lead, n_g, group_size, out)
+    mx = grouped.max(axis=-2, keepdims=True)
+    mn = grouped.min(axis=-2, keepdims=True)
+    scale = np.maximum((mx - mn) / 15.0, 1e-8)
+    # w ≈ q*scale + mn, i.e. zs = -mn.  Unlike AWQ's nibble-stored zeros,
+    # zs is bf16, so no [0,15] clamp: one-sided groups (all-positive mn>0)
+    # keep their full range instead of saturating at nibble 15.
+    q = np.clip(np.round((grouped - mn) / scale), 0, 15).astype(np.uint8)
+    packed = (q[..., :half, :] | (q[..., half:, :] << 4)).reshape(
+        *lead, in_dim // 2, out
+    )
+    s = np.squeeze(scale, axis=-2).astype(ml_dtypes.bfloat16)
+    zs = np.squeeze(-mn, axis=-2).astype(ml_dtypes.bfloat16)
+    return QuantizedLinear4(q=jnp.asarray(packed), s=jnp.asarray(s), zs=jnp.asarray(zs))
 
 
 def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
@@ -117,47 +204,65 @@ def embedding_lookup(embed, ids: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray
     return jnp.take(embed, ids, axis=0)
 
 
-def quantize_qwen2_params(params: dict, embeddings: bool = True) -> dict:
+def quantize_qwen2_params(
+    params: dict, embeddings: bool = True, bits: int = 8, group_size: int = 64
+) -> dict:
     """Quantize every linear projection of a Qwen2(-MoE) param tree
     (attention wq/wk/wv/wo, the dense MLP or the expert+shared-expert
     stacks, lm_head when present, and — by default — the embedding table,
     which a tied-weight model reads IN FULL every decode step for logits);
-    norms, biases, the MoE router, and the shared-expert gate stay bf16."""
+    norms, biases, the MoE router, and the shared-expert gate stay bf16.
+
+    ``bits=4`` switches projections to the AWQ-class group-wise uint4
+    scheme (quantize_weight4); the embedding table stays per-row int8
+    either way — AWQ itself keeps embeddings full precision, and a 4-bit
+    table would put its larger error on every token AND every logit."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    qw = (
+        quantize_weight
+        if bits == 8
+        else lambda w: quantize_weight4(w, group_size=group_size)
+    )
     out = dict(params)
     layers = dict(params["layers"])
     if "router" in layers:
         # MoE: experts + shared expert quantize with stacked per-expert
-        # scales ([L, E, ff] — _quantize_symmetric reduces axis -2 whatever
-        # the leading dims).  The router and the [d, 1] shared gate stay
-        # full precision: they are tiny and routing decisions are the
-        # precision-sensitive part of a sparse model.
+        # scales (the leading dims pass through both schemes).  The router
+        # and the [d, 1] shared gate stay full precision: they are tiny
+        # and routing decisions are the precision-sensitive part of a
+        # sparse model.
         mlp_names = ("e_wg", "e_wu", "e_wd", "s_wg", "s_wu", "s_wd")
     else:
         mlp_names = ("wg", "wu", "wd")
     for name in ("wq", "wk", "wv", "wo") + mlp_names:
-        layers[name] = quantize_weight(layers[name])
+        layers[name] = qw(layers[name])
     out["layers"] = layers
     if "lm_head" in params:
-        out["lm_head"] = quantize_weight(params["lm_head"])
+        out["lm_head"] = qw(params["lm_head"])
     if embeddings:
         out["embed"] = quantize_embedding(params["embed"])
     return out
 
 
-def init_params_quantized(cfg, seed: int = 0) -> dict:
-    """Random int8-quantized Qwen2 params, built HOST-side leaf by leaf (a
-    7B bf16 tree cannot be materialized on a 16 GB chip just to quantize
-    it; real checkpoints stream through quantize_weight shard by shard in
-    hf_loader).  Bench/test use: throughput is weight-value-independent."""
+def init_params_quantized(cfg, seed: int = 0, bits: int = 8,
+                          group_size: int = 64) -> dict:
+    """Random quantized Qwen2 params (int8 or AWQ-class int4), built
+    HOST-side leaf by leaf (a 7B bf16 tree cannot be materialized on a
+    16 GB chip just to quantize it; real checkpoints stream through
+    quantize_weight/quantize_weight4 shard by shard in hf_loader).
+    Bench/test use: throughput is weight-value-independent."""
     import ml_dtypes
     import numpy as np
 
     if getattr(cfg, "num_experts", 0):
         raise NotImplementedError(
-            "random int8 MoE init is not implemented (this helper exists for "
-            "dense-geometry benches); real MoE checkpoints quantize through "
-            "load_qwen2(..., quantize=True)"
+            "random quantized MoE init is not implemented (this helper exists "
+            "for dense-geometry benches); real MoE checkpoints quantize "
+            "through load_qwen2(..., quantize=True)"
         )
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
     rng = np.random.default_rng(seed)
     d, nq, nkv, hd, inter, L, v = (
         cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
@@ -169,11 +274,29 @@ def init_params_quantized(cfg, seed: int = 0) -> dict:
             (rng.standard_normal(shape) * 0.02).astype(ml_dtypes.bfloat16)
         )
 
-    def qlin(*shape):
+    def qlin8(*shape):
         q = jnp.asarray(rng.integers(-127, 128, shape, dtype=np.int8))
         # scale so dequantized std ~ 0.02 (uniform int8 std ~ 73)
         s = jnp.full(shape[:-2] + shape[-1:], 0.02 / 73.0, dtype=jnp.bfloat16)
         return QuantizedLinear(q=q, s=s)
+
+    def qlin4(*shape):
+        in_dim, out = shape[-2], shape[-1]
+        if group_size % 2 or in_dim % group_size:
+            raise ValueError(
+                f"input dim {in_dim} must be divisible by the (even) "
+                f"group_size {group_size} (same contract as quantize_weight4)"
+            )
+        packed = jnp.asarray(
+            rng.integers(0, 256, shape[:-2] + (in_dim // 2, out), dtype=np.uint8)
+        )
+        sshape = shape[:-2] + (in_dim // group_size, out)
+        # uniform uint4 std ~ 4.6; center with zs = 7.5*s
+        s = jnp.full(sshape, 0.02 / 4.6, dtype=jnp.bfloat16)
+        zs = jnp.full(sshape, 7.5 * 0.02 / 4.6, dtype=jnp.bfloat16)
+        return QuantizedLinear4(q=packed, s=s, zs=zs)
+
+    qlin = qlin8 if bits == 8 else qlin4
 
     layers = {
         "ln1": jnp.ones((L, d), dtype=jnp.bfloat16),

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from githubrepostorag_tpu.models.quant import (
     QuantizedEmbedding,
     QuantizedLinear,
+    QuantizedLinear4,
+    dequant_weight,
     embedding_lookup,
     qmatmul,
 )
@@ -327,9 +329,9 @@ def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
         return jnp.einsum(
             "bsd,vd->bsv", h, embed, preferred_element_type=jnp.float32
         )
-    if isinstance(lm_head, QuantizedLinear):
+    if isinstance(lm_head, (QuantizedLinear, QuantizedLinear4)):
         # dequantized per use; the convert+scale fuses into the dot
-        wd = lm_head.q.astype(h.dtype) * lm_head.s.astype(h.dtype)[None, :]
+        wd = dequant_weight(lm_head, h.dtype)
         return jnp.einsum("bsd,dv->bsv", h, wd, preferred_element_type=jnp.float32)
     return jnp.einsum(
         "bsd,dv->bsv", h, lm_head, preferred_element_type=jnp.float32

@@ -93,18 +93,29 @@ def qwen2_param_specs(cfg: Qwen2Config, mesh: Mesh, params: dict | None = None) 
         specs["lm_head"] = P(None, vocab_tp)
 
     if params is not None:
-        from githubrepostorag_tpu.models.quant import QuantizedLinear
+        from githubrepostorag_tpu.models.quant import QuantizedLinear, QuantizedLinear4
 
         def adapt(spec: P) -> QuantizedLinear:
             # q shards like the weight; s is per-output-channel -> shard
             # like the weight's trailing axis (leading stacked axes kept)
             return QuantizedLinear(q=spec, s=P(*spec[:-2], spec[-1]))
 
+        def adapt4(spec: P) -> QuantizedLinear4:
+            # int4: q is [.., in/2, out] plane-packed and s/zs are
+            # [.., in/group, out] — all three share the weight's rank and
+            # axis meaning, so the weight's spec applies verbatim (GSPMD
+            # pads if an axis size doesn't divide the smaller dims)
+            return QuantizedLinear4(q=spec, s=spec, zs=spec)
+
         for name, leaf in params["layers"].items():
             if isinstance(leaf, QuantizedLinear):
                 specs["layers"][name] = adapt(specs["layers"][name])
+            elif isinstance(leaf, QuantizedLinear4):
+                specs["layers"][name] = adapt4(specs["layers"][name])
         if isinstance(params.get("lm_head"), QuantizedLinear):
             specs["lm_head"] = adapt(specs["lm_head"])
+        elif isinstance(params.get("lm_head"), QuantizedLinear4):
+            specs["lm_head"] = adapt4(specs["lm_head"])
         from githubrepostorag_tpu.models.quant import QuantizedEmbedding
 
         if isinstance(params["embed"], QuantizedEmbedding):

@@ -43,7 +43,7 @@ async def serve(host: str, port: int) -> None:
         raise SystemExit("model server requires MODEL_WEIGHTS_PATH (a local HF checkpoint dir)")
     logger.info(
         "loading weights from %s%s", s.model_weights_path,
-        " (int8 weight-only)" if s.quantize_weights else "",
+        f" (int{s.quantize_weights} weight-only)" if s.quantize_weights else "",
     )
     params, cfg = load_qwen2(
         s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights

@@ -1,0 +1,206 @@
+"""Int4 (AWQ-class) weight-only quantization: roundtrip error, packing,
+forward parity, engine integration, TP composition, AWQ repacking.
+
+The reference's deployed model is 4-bit AWQ (vLLM serving
+Qwen2.5-Coder-7B-Instruct-AWQ — /root/reference/helm/values.yaml:67);
+models/quant.py::QuantizedLinear4 is the TPU-native equivalent: group-wise
+asymmetric uint4, plane-packed two nibbles per byte, dequant fused into the
+consuming dot by XLA.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.models.quant import (
+    QuantizedLinear4,
+    dequantize,
+    init_params_quantized,
+    qmatmul,
+    quantize_qwen2_params,
+    quantize_weight4,
+)
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, forward, init_params
+
+G = 16  # group size that divides the tiny config's dims (real configs use 64)
+
+
+def test_quantize4_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(0, 0.02, (64, 128)), dtype=jnp.float32)
+    qt = quantize_weight4(w, group_size=G)
+    assert qt.q.dtype == jnp.uint8 and qt.q.shape == (32, 128)
+    assert qt.s.shape == (64 // G, 128) and qt.zs.shape == (64 // G, 128)
+    err = np.abs(np.asarray(dequantize(qt, jnp.float32)) - np.asarray(w))
+    # asymmetric int4: half a step = (max-min)/30 per group, plus bf16
+    # storage error on s/zs
+    max_step = float(np.asarray(qt.s, dtype=np.float32).max())
+    assert err.max() <= max_step * 1.2, (err.max(), max_step)
+
+
+def test_quantize4_preserves_group_extremes():
+    """Asymmetric quantization maps each group's min to nibble 0 and max to
+    nibble 15, so the extreme values survive the roundtrip (up to bf16
+    storage of s/zs) — the property that distinguishes asymmetric from
+    symmetric int4, which wastes half a nibble on one-sided groups."""
+    rng = np.random.default_rng(1)
+    w = rng.uniform(0.5, 1.5, (32, 8)).astype(np.float32)  # one-sided values
+    qt = quantize_weight4(jnp.asarray(w), group_size=16)
+    back = np.asarray(dequantize(qt, jnp.float32)).reshape(2, 16, 8)
+    wg = w.reshape(2, 16, 8)
+    np.testing.assert_allclose(back.max(1), wg.max(1), rtol=2e-2)
+    np.testing.assert_allclose(back.min(1), wg.min(1), rtol=2e-2, atol=2e-2)
+
+
+def test_quantize4_stacked_layers_shapes():
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(0, 0.02, (3, 32, 48)), dtype=jnp.float32)
+    qt = quantize_weight4(w, group_size=G)
+    assert qt.q.shape == (3, 16, 48) and qt.s.shape == (3, 2, 48)
+    deq = dequantize(qt, jnp.float32)
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(w), atol=6e-3)
+
+
+def test_quantize4_rejects_misaligned_dims():
+    import pytest
+
+    w = jnp.zeros((24, 8), dtype=jnp.float32)  # 24 % (2*16) != 0
+    with pytest.raises(ValueError):
+        quantize_weight4(w, group_size=16)
+
+
+def test_qmatmul4_matches_dequant_matmul():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(4, 64)), dtype=jnp.float32)
+    w = jnp.asarray(rng.normal(0, 0.02, (64, 128)), dtype=jnp.float32)
+    qt = quantize_weight4(w, group_size=G)
+    np.testing.assert_allclose(
+        np.asarray(qmatmul(x, qt)), np.asarray(x @ dequantize(qt, jnp.float32)),
+        rtol=2e-2, atol=2e-4,
+    )
+
+
+def test_quantized4_forward_tracks_bf16_logits():
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qparams = quantize_qwen2_params(params, bits=4, group_size=G)
+    assert isinstance(qparams["layers"]["wq"], QuantizedLinear4)
+    ids = jnp.asarray(np.random.default_rng(4).integers(0, cfg.vocab_size, (2, 16)),
+                      dtype=jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+    ref, _ = forward(params, cfg, ids, pos)
+    out, _ = forward(qparams, cfg, ids, pos)
+    a = np.asarray(ref).reshape(-1).astype(np.float64)
+    b = np.asarray(out).reshape(-1).astype(np.float64)
+    corr = np.dot(a - a.mean(), b - b.mean()) / (np.std(a) * np.std(b) * a.size)
+    assert corr > 0.995, corr  # group-16 int4 tracks fp at init scale
+
+
+def test_engine_runs_with_int4_params():
+    from githubrepostorag_tpu.serving import Engine, SamplingParams
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    qparams = quantize_qwen2_params(params, bits=4, group_size=G)
+    eng = Engine(qparams, cfg, max_num_seqs=2, num_pages=32, page_size=4,
+                 max_seq_len=64, kv_dtype=jnp.float32, decode_burst=8)
+    res = eng.generate([[1, 2, 3, 4, 5]],
+                       SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=()))[0]
+    assert len(res.output_tokens) == 8
+    assert res.finish_reason == "length"
+
+
+def test_tp2_engine_with_int4_params_token_identical():
+    """Int4 composes with TP sharding exactly like int8: the specs tree
+    mirrors QuantizedLinear4 (q/s/zs all shard with the weight's spec) and
+    tp=2 greedy decode matches the single-device int4 engine."""
+    from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
+    from githubrepostorag_tpu.serving import Engine, SamplingParams
+
+    cfg = Qwen2Config.tiny()
+    qparams = quantize_qwen2_params(
+        init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32),
+        bits=4, group_size=G,
+    )
+
+    def run(mesh):
+        eng = Engine(qparams, cfg, max_num_seqs=2, num_pages=32, page_size=4,
+                     max_seq_len=64, kv_dtype=jnp.float32, decode_burst=8,
+                     mesh=mesh)
+        sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+        return [r.output_tokens for r in eng.generate([[1, 2, 3], [6, 5, 4]], sp)]
+
+    assert run(make_mesh(MeshPlan(tp=2))) == run(None)
+
+
+def test_init_params_quantized4_shapes():
+    cfg = Qwen2Config.tiny()
+    params = init_params_quantized(cfg, bits=4, group_size=G)
+    wq = params["layers"]["wq"]
+    assert isinstance(wq, QuantizedLinear4)
+    L, d = cfg.num_layers, cfg.hidden_size
+    assert wq.q.shape == (L, d // 2, cfg.num_heads * cfg.head_dim)
+    assert wq.s.shape == (L, d // G, cfg.num_heads * cfg.head_dim)
+
+
+def test_awq_unpack_known_word():
+    """Pin the AutoAWQ GEMM nibble layout against a hand-packed word (not a
+    round trip through the same constant): columns 0..7 with values 0..7
+    pack — per AutoAWQ's order_map [0,2,4,6,1,3,5,7] — into nibbles
+    (low..high) 0,2,4,6,1,3,5,7 = 0x75316420."""
+    from githubrepostorag_tpu.models.hf_loader import _awq_unpack
+
+    word = np.array([[0x75316420]], dtype=np.uint32).view(np.int32)
+    got = _awq_unpack(word)
+    np.testing.assert_array_equal(got, np.arange(8, dtype=np.uint8)[None, :])
+
+
+def test_awq_repack_roundtrip():
+    """Synthetic AutoAWQ GEMM-format tensors repack losslessly: build
+    known uint4 q / zeros / scales, pack them the AWQ way (8 nibbles per
+    int32, interleaved column order), repack via awq_linear_to_quantized4,
+    and check dequant equals the direct (q - z) * s reference."""
+    from githubrepostorag_tpu.models.hf_loader import (
+        AWQ_NIBBLE_ORDER,
+        awq_linear_to_quantized4,
+    )
+
+    rng = np.random.default_rng(6)
+    in_dim, out, group = 32, 16, 8
+    q = rng.integers(0, 16, (in_dim, out)).astype(np.uint8)
+    z = rng.integers(0, 16, (in_dim // group, out)).astype(np.uint8)
+    s = rng.uniform(0.01, 0.03, (in_dim // group, out)).astype(np.float32)
+
+    def awq_pack(u4: np.ndarray) -> np.ndarray:
+        r, c = u4.shape
+        packed = np.zeros((r, c // 8), dtype=np.uint32)
+        for pos, col in enumerate(AWQ_NIBBLE_ORDER):
+            packed |= u4[:, col::8].astype(np.uint32) << np.uint32(4 * pos)
+        return packed.view(np.int32)
+
+    qt = awq_linear_to_quantized4(awq_pack(q), awq_pack(z), s)
+    got = np.asarray(dequantize(qt, jnp.float32))
+    ref = (q.astype(np.float32) - np.repeat(z, group, 0)) * np.repeat(s, group, 0)
+    # s/zs stored bf16: tolerance is bf16 eps on the scale magnitudes
+    np.testing.assert_allclose(got, ref, atol=2e-3)
+
+
+def test_int4_halves_weight_bytes_vs_int8():
+    """At real geometry (0.5B MLP projection, group 64) int4 weights+scales
+    are ~56% of int8 weights+scales — the HBM-read halving the 7B decode
+    bench banks on."""
+    from githubrepostorag_tpu.models.quant import params_nbytes, quantize_weight
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(0, 0.02, (896, 4864)), dtype=jnp.float32)
+    n8 = sum(leaf.nbytes for leaf in jax.tree.leaves(quantize_weight(w)._asdict()))
+    n4 = sum(
+        leaf.nbytes
+        for leaf in jax.tree.leaves(quantize_weight4(w, group_size=64)._asdict())
+    )
+    assert n4 < 0.6 * n8, (n4, n8)
+
+    cfg = Qwen2Config.tiny()
+    assert params_nbytes(init_params_quantized(cfg, bits=4, group_size=G)) < \
+        params_nbytes(init_params_quantized(cfg, bits=8))
