@@ -61,6 +61,16 @@ SPEC_ACCEPTED = Counter(
     "Speculative draft tokens the model accepted and committed",
     registry=REGISTRY,
 )
+MOE_ASSIGNMENTS = Counter(
+    "rag_moe_expert_assignments_total",
+    "MoE router token->expert assignments offered (MOE_DROP_STATS=1)",
+    registry=REGISTRY,
+)
+MOE_DROPPED = Counter(
+    "rag_moe_dropped_assignments_total",
+    "MoE assignments dropped by expert capacity (MOE_DROP_STATS=1)",
+    registry=REGISTRY,
+)
 
 
 def render() -> bytes:

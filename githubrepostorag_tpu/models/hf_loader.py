@@ -29,7 +29,11 @@ def _np(t) -> np.ndarray:
     return t.detach().to("cpu").float().numpy()
 
 
-def config_from_hf(hf_cfg: dict) -> Qwen2Config:
+def config_from_hf(hf_cfg: dict, moe_capacity_factor: float = 2.0) -> Qwen2Config:
+    """Pure parser: HF config dict -> Qwen2Config.  ``moe_capacity_factor``
+    is caller-supplied (the serving entrypoint threads
+    Settings.moe_capacity_factor through load_qwen2) so parsing the same
+    config.json never depends on process env."""
     num_heads = hf_cfg["num_attention_heads"]
     moe: dict = {}
     if hf_cfg.get("num_experts", 0):  # Qwen2MoeConfig (model_type qwen2_moe)
@@ -45,10 +49,10 @@ def config_from_hf(hf_cfg: dict) -> Qwen2Config:
             moe_intermediate_size=hf_cfg["moe_intermediate_size"],
             shared_expert_intermediate_size=hf_cfg["shared_expert_intermediate_size"],
             norm_topk_prob=hf_cfg.get("norm_topk_prob", False),
-            # serving default: bounded-capacity dispatch.  The exact no-drop
-            # mode (capacity_factor=0) builds [T, E, T] dispatch tensors —
-            # parity-test scale only; override via dataclasses.replace
-            capacity_factor=2.0,
+            # bounded-capacity dispatch (MOE_DROP_STATS=1 counts drops).
+            # The exact no-drop mode (factor 0) builds [T, E, T] dispatch
+            # tensors — parity-test scale only.
+            capacity_factor=moe_capacity_factor,
         )
     return Qwen2Config(
         vocab_size=hf_cfg["vocab_size"],
@@ -128,7 +132,10 @@ def params_from_state_dict(state_dict: dict, cfg: Qwen2Config, dtype=np.float32)
 
 
 def load_qwen2(
-    checkpoint_dir: str, dtype=np.float32, quantize: bool | int = False
+    checkpoint_dir: str,
+    dtype=np.float32,
+    quantize: bool | int = False,
+    moe_capacity_factor: float = 2.0,
 ) -> tuple[dict, Qwen2Config]:
     """Load config.json + *.safetensors from a local directory.
 
@@ -147,7 +154,7 @@ def load_qwen2(
 
     root = Path(checkpoint_dir)
     hf_cfg = json.loads((root / "config.json").read_text())
-    cfg = config_from_hf(hf_cfg)
+    cfg = config_from_hf(hf_cfg, moe_capacity_factor=moe_capacity_factor)
 
     state: dict[str, np.ndarray] = {}
     for shard in sorted(root.glob("*.safetensors")):

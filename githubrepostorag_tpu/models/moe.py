@@ -27,6 +27,32 @@ import jax.numpy as jnp
 
 from githubrepostorag_tpu.models.quant import dequant_weight, qmatmul
 
+# Host-side routing-drop accumulator (ADVICE r02: bounded-capacity dispatch
+# silently loses expert contributions under router imbalance — make the
+# drop rate observable).  MOE_DROP_STATS=1 enables a per-layer
+# jax.debug.callback that adds (assignments, dropped) here and to the
+# Prometheus counters; off by default because the callback forces a
+# host round trip per MoE layer.
+DROP_STATS = {"assignments": 0, "dropped": 0}
+
+
+def _drop_stats_enabled() -> bool:
+    from githubrepostorag_tpu.config import _env_bool
+
+    return _env_bool("MOE_DROP_STATS", False)
+
+
+def _record_drops(assignments, dropped) -> None:
+    DROP_STATS["assignments"] += int(assignments)
+    DROP_STATS["dropped"] += int(dropped)
+    try:
+        from githubrepostorag_tpu.metrics import MOE_ASSIGNMENTS, MOE_DROPPED
+
+        MOE_ASSIGNMENTS.inc(int(assignments))
+        MOE_DROPPED.inc(int(dropped))
+    except Exception:  # pragma: no cover - metrics registry optional in tools
+        pass
+
 
 def moe_mlp(cfg, p: dict, x: jnp.ndarray) -> jnp.ndarray:
     """Sparse MoE MLP over normed hidden states ``x`` [B, S, d].
@@ -59,6 +85,10 @@ def moe_mlp(cfg, p: dict, x: jnp.ndarray) -> jnp.ndarray:
     pos = jnp.cumsum(oh_flat, axis=0) - oh_flat
     slot = (pos * oh_flat).sum(-1)  # [T*K] this assignment's queue position
     keep = slot < C
+    if cfg.capacity_factor > 0 and _drop_stats_enabled():
+        jax.debug.callback(
+            _record_drops, jnp.asarray(T * K), (~(slot < C)).sum()
+        )
     slot_oh = (jax.nn.one_hot(slot, C, dtype=jnp.float32) * keep[:, None]).reshape(T, K, C)
     # contract k inside the einsums: a materialized [T, K, E, C] would be
     # K times the memory of the [T, E, C] tensors actually needed

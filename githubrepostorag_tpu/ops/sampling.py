@@ -19,6 +19,15 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _exact_topk() -> bool:
+    """SAMPLING_EXACT_TOPK=1 -> exact full-vocab candidate selection in
+    sample_tokens_capped (read per trace, so flipping the env between
+    engine constructions takes effect on the next compile)."""
+    from githubrepostorag_tpu.config import _env_bool
+
+    return _env_bool("SAMPLING_EXACT_TOPK", False)
+
+
 def apply_repetition_penalty(
     logits: jnp.ndarray,  # [B, V] float32
     presence: jnp.ndarray,  # [B, V] bool — token appeared in prompt or output
@@ -82,14 +91,23 @@ def sample_tokens_capped(
     no correctness impact, greedy rows use the separate exact argmax below.
     Exact nucleus whenever it fits the cap, which holds for every sampling
     config in the system (reference clients use top_p 0.8/0.9 at
-    temperature <= 0.7 — qwen_llm.py:107-114)."""
+    temperature <= 0.7 — qwen_llm.py:107-114).
+
+    SAMPLING_EXACT_TOPK=1 swaps the approximate candidate pull for an
+    exact ``lax.top_k`` over the full vocab — the escape hatch for
+    reproducibility-sensitive evals where the ~(1-recall)/2-per-step
+    chance of a missing tail candidate matters more than the ~15%
+    decode-throughput cost."""
     logits = apply_repetition_penalty(logits, presence, repetition_penalty[:, None])
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     vocab = logits.shape[-1]
     pool = min(2 * cap, vocab)
-    pool_vals, pool_idx = jax.lax.approx_max_k(scaled, pool, recall_target=0.99)
+    if _exact_topk():
+        pool_vals, pool_idx = jax.lax.top_k(scaled, pool)
+    else:
+        pool_vals, pool_idx = jax.lax.approx_max_k(scaled, pool, recall_target=0.99)
     vals, within = jax.lax.top_k(pool_vals, cap)  # exact rank inside the pool
     idx = jnp.take_along_axis(pool_idx, within, axis=-1).astype(jnp.int32)
     # top-k within the cap: positions >= k masked (k<=0 disables)

@@ -46,7 +46,8 @@ async def serve(host: str, port: int) -> None:
         f" (int{s.quantize_weights} weight-only)" if s.quantize_weights else "",
     )
     params, cfg = load_qwen2(
-        s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights
+        s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights,
+        moe_capacity_factor=s.moe_capacity_factor,
     )
 
     # TP-shard the decoder over the chip's ICI mesh (vLLM's
@@ -59,14 +60,22 @@ async def serve(host: str, port: int) -> None:
         from githubrepostorag_tpu.parallel import plan_from_string
 
         plan = plan_from_string(s.mesh_shape)
-        if plan.dp > 1 or plan.pp > 1 or plan.ep > 1:
-            # the serving engine shards over tp (params/pools/kernel) and sp
-            # (ring prefill) only; a dp/pp/ep axis would silently replicate
-            # every step's work across those chips
+        if plan.dp > 1 or plan.pp > 1:
+            # the serving engine shards over tp (params/pools/kernel), sp
+            # (ring prefill), and — for MoE checkpoints — ep (expert
+            # stacks); a dp/pp axis would silently replicate every step's
+            # work across those chips
             raise SystemExit(
-                f"MESH_SHAPE={s.mesh_shape!r}: serving uses tp and sp axes only "
-                "— for data-parallel serving run one server pod per replica "
-                "(each with its own tp/sp group)"
+                f"MESH_SHAPE={s.mesh_shape!r}: serving uses tp, sp, and (for "
+                "MoE models) ep axes — for data-parallel serving run one "
+                "server pod per replica (each with its own tp/sp/ep group)"
+            )
+        if plan.ep > 1 and cfg.num_experts == 0:
+            raise SystemExit(
+                f"MESH_SHAPE={s.mesh_shape!r}: ep shards the expert stacks of "
+                f"an MoE checkpoint, but {s.model_weights_path} is a dense "
+                "model (num_experts=0) — ep chips would replicate its work; "
+                "use tp/sp instead"
             )
     else:
         plan = plan_for_devices(

@@ -918,6 +918,23 @@ class Engine:
         for nb in buckets:
             prompts = [[1, 2, 3]] * nb
             self.generate(prompts, sp)
+        if self.sp_prefill_threshold is not None and self._sp > 1:
+            # precompile the ring-prefill program at every width bucket a
+            # live prompt can hit (ADVICE r02: without this, the first
+            # above-threshold prompt — and each new width — pays a
+            # multi-second-to-minutes XLA compile mid-request, violating
+            # the warmed-shapes discipline stated in _prefill_batch)
+            width = 1
+            while width < max(self.sp_prefill_threshold, self._sp):
+                width *= 2
+            while True:
+                width = min(width, self.max_seq_len)
+                n = min(width, self.max_seq_len - 2)  # room for 2 tokens
+                if n >= self.sp_prefill_threshold:
+                    self.generate([[1] * n], sp)
+                if width >= self.max_seq_len:
+                    break
+                width *= 2
         if self.prefix_caching:
             # the cached-prefix presence-marking program ([1, max_seq] shape)
             # only runs on cache hits; compile it now with a zero-length mark

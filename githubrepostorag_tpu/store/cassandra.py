@@ -7,9 +7,11 @@ SimpleStrategy RF=1, one table per hierarchy scope with a cosine SAI index on
 ``vector`` and an entries index on ``metadata_s``, idempotent upserts keyed by
 ``row_id``.
 
-Gated on the ``cassandra-driver`` package: importing this module without it
-raises a clear error, and the factory only reaches here when
-STORE_BACKEND=cassandra.
+Speaks CQL through the IN-TREE native-protocol v4 client (store/cql.py) —
+no cassandra-driver dependency, same pattern as the in-tree RESP2 Redis
+client (events/resp.py).  The wire path is exercised in CI against
+tests/minicassandra.py, a real TCP server speaking the same protocol
+(tests/test_cql_wire.py).
 """
 
 from __future__ import annotations
@@ -19,14 +21,7 @@ from typing import Iterable, Mapping, Sequence
 import numpy as np
 
 from githubrepostorag_tpu.store.base import Doc, SearchHit, VectorStore, filter_entries
-
-try:  # pragma: no cover - exercised only with live infra
-    from cassandra.auth import PlainTextAuthProvider
-    from cassandra.cluster import Cluster
-
-    _HAVE_DRIVER = True
-except ImportError:  # pragma: no cover
-    _HAVE_DRIVER = False
+from githubrepostorag_tpu.store.cql import CQLCluster
 
 
 _DDL_KEYSPACE = (
@@ -63,7 +58,7 @@ def _row_doc(r) -> "Doc":
     )
 
 
-class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only
+class CassandraVectorStore(VectorStore):
     def __init__(
         self,
         hosts: list[str],
@@ -73,13 +68,9 @@ class CassandraVectorStore(VectorStore):  # pragma: no cover - live-infra only
         keyspace: str = "vector_store",
         embed_dim: int = 384,
     ) -> None:
-        if not _HAVE_DRIVER:
-            raise ImportError(
-                "STORE_BACKEND=cassandra requires the cassandra-driver package; "
-                "use STORE_BACKEND=memory or STORE_BACKEND=native otherwise"
-            )
-        auth = PlainTextAuthProvider(username=username, password=password)
-        self._cluster = Cluster(contact_points=hosts, port=port, auth_provider=auth)
+        self._cluster = CQLCluster(
+            contact_points=hosts, port=port, username=username, password=password
+        )
         self._session = self._cluster.connect()
         self._ks = keyspace
         self._dim = embed_dim

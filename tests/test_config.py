@@ -35,3 +35,33 @@ def test_bad_env_int_falls_back(monkeypatch):
     monkeypatch.setenv("ROUTER_TOP_K", "not-a-number")
     s = reload_settings()
     assert s.router_top_k == 5
+
+
+def test_quantize_weights_values(monkeypatch):
+    from githubrepostorag_tpu.config import reload_settings
+
+    for raw, want in [("int4", 4), ("int8", 8), ("true", 8), ("4", 4),
+                      ("", 0), ("false", 0)]:
+        monkeypatch.setenv("QUANTIZE_WEIGHTS", raw)
+        assert reload_settings().quantize_weights == want, raw
+
+
+def test_quantize_weights_typo_raises(monkeypatch):
+    import pytest
+
+    from githubrepostorag_tpu.config import reload_settings
+
+    monkeypatch.setenv("QUANTIZE_WEIGHTS", "in8")
+    with pytest.raises(ValueError, match="QUANTIZE_WEIGHTS"):
+        reload_settings()
+    monkeypatch.setenv("QUANTIZE_WEIGHTS", "int8")
+    reload_settings()
+
+
+def test_moe_capacity_factor_env(monkeypatch):
+    from githubrepostorag_tpu.config import reload_settings
+
+    monkeypatch.setenv("MOE_CAPACITY_FACTOR", "1.25")
+    assert reload_settings().moe_capacity_factor == 1.25
+    monkeypatch.delenv("MOE_CAPACITY_FACTOR")
+    assert reload_settings().moe_capacity_factor == 2.0

@@ -147,3 +147,29 @@ def test_sp_prefill_registers_prefix_for_chunked_followers(tiny):
     got = eng.generate([short_p], sp)[0].output_tokens
     assert got == expected
     assert eng._allocator.hit_tokens == 24  # 3 pages resumed from the cache
+
+
+def test_warmup_precompiles_ring_prefill_buckets(tiny):
+    """ADVICE r02: warmup() must run a throwaway above-threshold prompt per
+    ring-prefill width bucket, so the first live long prompt never pays the
+    ring program's XLA compile mid-request.  With threshold 40 and
+    max_seq_len 256 the width buckets a prompt can hit are 64/128/256 ->
+    three sp prefills during warmup."""
+    _, params, cfg = tiny
+    eng = _sp_engine(params, cfg, threshold=40)
+    eng.warmup()
+    assert eng.sp_prefills == 3
+    # engine state is clean after warmup: a real request still works and
+    # takes the sp path without growing the compile count
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    long_p = np.random.default_rng(5).integers(0, cfg.vocab_size, 64).tolist()
+    expected = _engine(params, cfg).generate([long_p], sp)[0].output_tokens
+    assert eng.generate([long_p], sp)[0].output_tokens == expected
+    assert eng.sp_prefills == 4
+
+
+def test_warmup_skips_ring_prefill_when_disabled(tiny):
+    _, params, cfg = tiny
+    eng = _engine(params, cfg)  # no sp axis, no threshold
+    eng.warmup()
+    assert eng.sp_prefills == 0

@@ -229,3 +229,34 @@ def test_moe_sharded_train_step(tiny_moe):
     assert np.isfinite(float(loss))
     after = np.asarray(params["layers"]["e_wg"])
     assert np.abs(after - before).sum() > 0, "expert weights did not update"
+
+
+def test_moe_drop_stats_counter(tiny_moe, monkeypatch):
+    """MOE_DROP_STATS=1 makes bounded-capacity dispatch observable: a
+    router forced to send every token to one expert under a tight capacity
+    must report drops (ADVICE r02 — silent contribution loss)."""
+    import dataclasses
+
+    from githubrepostorag_tpu.models import moe
+
+    _, params, cfg = tiny_moe
+    cfg = dataclasses.replace(cfg, capacity_factor=0.5)
+    # all tokens to expert 0: bias the router column hard
+    lay = dict(params["layers"])
+    router = np.asarray(lay["router"]).copy()
+    router[:, :, 0] += 100.0
+    lay["router"] = jnp.asarray(router)
+    monkeypatch.setenv("MOE_DROP_STATS", "1")
+    moe.DROP_STATS["assignments"] = moe.DROP_STATS["dropped"] = 0
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8, cfg.hidden_size)),
+                    dtype=jnp.float32)
+    p0 = jax.tree.map(lambda l: l[0], lay)
+    jax.block_until_ready(moe.moe_mlp(cfg, p0, x))
+    assert moe.DROP_STATS["assignments"] == 2 * 8 * cfg.num_experts_per_tok
+    assert moe.DROP_STATS["dropped"] > 0
+
+    # disabled -> no callback, counters untouched
+    monkeypatch.delenv("MOE_DROP_STATS")
+    moe.DROP_STATS["assignments"] = moe.DROP_STATS["dropped"] = 0
+    jax.block_until_ready(moe.moe_mlp(cfg, p0, x))
+    assert moe.DROP_STATS == {"assignments": 0, "dropped": 0}
