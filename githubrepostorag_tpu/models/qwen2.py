@@ -166,14 +166,23 @@ def init_params(cfg: Qwen2Config, key: jax.Array, dtype=jnp.float32) -> dict:
     return params
 
 
-def _block(cfg: Qwen2Config, h, p, cos, sin, attend):
+def _block(cfg: Qwen2Config, h, p, cos, sin, attend, reduce=None):
     """One transformer block.  ``attend(q, k, v) -> (attn_out, cache_info)``
     commits this step's K/V into whatever cache representation the caller
     uses (dense slab, page pool, or nothing) and returns the attention
     output.  Both the dense and paged forward paths share this body, so
-    projection/RoPE/MLP changes cannot drift between them."""
+    projection/RoPE/MLP changes cannot drift between them.
+
+    ``reduce``: applied to the two row-parallel products (wo and wd) before
+    the residual add.  Callers running this body INSIDE a shard_map with
+    tensor-parallel weight shards (training/pipeline.py's tp-in-stage)
+    pass ``lambda x: lax.psum(x, "tp")`` and a cfg whose head counts are
+    the LOCAL per-shard counts; annotation-driven (GSPMD) callers leave it
+    None — the compiler inserts the same psums from the param shardings."""
     b, s, d = h.shape
     nq, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    if reduce is None:
+        reduce = lambda x: x
 
     hn = rms_norm(h, p["ln1"], cfg.rms_norm_eps)
     q = (qmatmul(hn, p["wq"]) + p["bq"]).reshape(b, s, nq, hd)
@@ -182,7 +191,7 @@ def _block(cfg: Qwen2Config, h, p, cos, sin, attend):
     q, k = apply_rope(q, k, cos, sin)
 
     attn, cache_info = attend(q, k, v)
-    h = h + qmatmul(attn.reshape(b, s, nq * hd), p["wo"])
+    h = h + reduce(qmatmul(attn.reshape(b, s, nq * hd), p["wo"]))
 
     hn = rms_norm(h, p["ln2"], cfg.rms_norm_eps)
     if "router" in p:  # sparse MoE MLP (Qwen2-MoE family, models/moe.py)
@@ -190,7 +199,9 @@ def _block(cfg: Qwen2Config, h, p, cos, sin, attend):
 
         h = h + moe_mlp(cfg, p, hn)
     else:
-        h = h + qmatmul(jax.nn.silu(qmatmul(hn, p["wg"])) * qmatmul(hn, p["wu"]), p["wd"])
+        h = h + reduce(
+            qmatmul(jax.nn.silu(qmatmul(hn, p["wg"])) * qmatmul(hn, p["wu"]), p["wd"])
+        )
     return h, cache_info
 
 

@@ -60,15 +60,13 @@ async def serve(host: str, port: int) -> None:
         from githubrepostorag_tpu.parallel import plan_from_string
 
         plan = plan_from_string(s.mesh_shape)
-        if plan.dp > 1 or plan.pp > 1:
+        if plan.pp > 1:
             # the serving engine shards over tp (params/pools/kernel), sp
-            # (ring prefill), and — for MoE checkpoints — ep (expert
-            # stacks); a dp/pp axis would silently replicate every step's
-            # work across those chips
+            # (ring prefill), ep (MoE expert stacks), and dp (in-process
+            # engine replicas); pipeline stages have no serving schedule
             raise SystemExit(
-                f"MESH_SHAPE={s.mesh_shape!r}: serving uses tp, sp, and (for "
-                "MoE models) ep axes — for data-parallel serving run one "
-                "server pod per replica (each with its own tp/sp/ep group)"
+                f"MESH_SHAPE={s.mesh_shape!r}: serving supports tp/sp/ep/dp "
+                "axes — pp is a training-side axis (training/pipeline.py)"
             )
         if plan.ep > 1 and cfg.num_experts == 0:
             raise SystemExit(
@@ -82,37 +80,67 @@ async def serve(host: str, port: int) -> None:
             n, num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads, role="serve"
         )
         plan = MeshPlan(tp=plan.tp)
-    mesh = make_mesh(plan) if plan.n_devices > 1 else None
-    if mesh is not None:
-        logger.info("serving mesh %s over %d devices", dict(mesh.shape), n)
-        if plan.n_devices < n:
-            logger.info(
-                "%d devices idle (DP serving = one engine replica per group; "
-                "run more server pods to use them)", n - plan.n_devices
-            )
 
     # tokenizer first: a broken tokenizer config must fail fast, not after
     # minutes of XLA warmup compiles
     tokenizer = make_tokenizer(s.model_weights_path)
     logger.info("tokenizer: %s", type(tokenizer).__name__)
-    engine = Engine(
-        params, cfg,
-        max_num_seqs=s.max_num_seqs,
-        num_pages=s.kv_num_pages,
-        page_size=s.kv_page_size,
-        max_seq_len=s.context_window,
-        prefill_chunk=s.prefill_chunk,
-        use_pallas=jax.default_backend() == "tpu",
-        mesh=mesh,
-        prefix_caching=s.prefix_caching,
-        sp_prefill_threshold=s.sp_prefill_threshold or None,
-        spec_ngram_k=s.spec_ngram_k,
-    )
-    logger.info("precompiling engine programs (prefill buckets + decode burst)")
-    engine.warmup()
-    server = OpenAIServer(
-        AsyncEngine(engine), tokenizer, model_name=s.qwen_model
-    )
+
+    def build_engine(mesh) -> Engine:
+        return Engine(
+            params, cfg,
+            max_num_seqs=s.max_num_seqs,
+            num_pages=s.kv_num_pages,
+            page_size=s.kv_page_size,
+            max_seq_len=s.context_window,
+            prefill_chunk=s.prefill_chunk,
+            use_pallas=jax.default_backend() == "tpu",
+            mesh=mesh,
+            prefix_caching=s.prefix_caching,
+            sp_prefill_threshold=s.sp_prefill_threshold or None,
+            spec_ngram_k=s.spec_ngram_k,
+        )
+
+    if plan.dp > 1:
+        # dp-grouped in-process replicas, one per disjoint submesh
+        # (serving/multi_engine.py); requests load-balance at admission
+        from githubrepostorag_tpu.serving.multi_engine import (
+            MultiAsyncEngine,
+            dp_submeshes,
+        )
+
+        meshes, groups = dp_submeshes(plan)
+        logger.info(
+            "dp serving: %d engine replicas x %d devices each (%s)",
+            plan.dp, len(groups[0]), dict(meshes[0].shape),
+        )
+        engines = []
+        for i, m in enumerate(meshes):
+            logger.info("precompiling engine replica %d/%d", i + 1, plan.dp)
+            eng = build_engine(m)
+            eng.warmup()
+            engines.append(eng)
+        async_engine = MultiAsyncEngine(engines)
+    else:
+        mesh = make_mesh(plan) if plan.n_devices > 1 else None
+        if mesh is not None:
+            logger.info("serving mesh %s over %d devices", dict(mesh.shape), n)
+            if plan.n_devices < n:
+                axes = [
+                    f"{name}:{size}"
+                    for name, size in plan.shape().items()
+                    if size > 1
+                ] + [f"dp:{n // plan.n_devices}"]
+                logger.info(
+                    "%d devices idle (MESH_SHAPE=%s would run %d engine "
+                    "replicas in this process)",
+                    n - plan.n_devices, ",".join(axes), n // plan.n_devices,
+                )
+        logger.info("precompiling engine programs (prefill buckets + decode burst)")
+        engine = build_engine(mesh)
+        engine.warmup()
+        async_engine = AsyncEngine(engine)
+    server = OpenAIServer(async_engine, tokenizer, model_name=s.qwen_model)
     bound = await server.start(host=host, port=port)
     logger.info("model server up on %s:%d (backend=%s)", host, bound, jax.default_backend())
     while True:  # serve until the pod is killed

@@ -156,6 +156,7 @@ class AsyncEngine:
             return {
                 "running": self.engine.num_running,
                 "waiting": self.engine.num_waiting,
+                "requests_admitted": self.engine.requests_admitted,
                 "free_pages": self.engine._allocator.free_count,
                 "total_pages": self.engine._allocator.num_pages,
                 "prefix_cache_hit_tokens": getattr(

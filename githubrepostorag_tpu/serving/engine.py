@@ -161,6 +161,7 @@ class Engine:
         self.spec_ngram_k = spec_ngram_k
         self.spec_proposed = 0  # stats: draft tokens offered / accepted
         self.spec_accepted = 0
+        self.requests_admitted = 0  # cumulative add_request count
 
         # host-side batch state
         self._block_tables = np.zeros((max_num_seqs, self.max_pages_per_seq), dtype=np.int32)
@@ -223,6 +224,7 @@ class Engine:
         if len(req.prompt) + sampling.max_tokens > self.max_seq_len:
             req.sampling = sampling.clamped(self.max_seq_len - len(req.prompt))
         self._requests[rid] = req
+        self.requests_admitted += 1
         error = None
         if not req.prompt or len(req.prompt) >= self.max_seq_len:
             error = "prompt empty or exceeds max_seq_len"

@@ -1,0 +1,129 @@
+"""dp-grouped multi-engine serving: several Engine replicas in ONE server
+process, each on its own disjoint submesh.
+
+``MESH_SHAPE=tp:4,dp:2`` on a v5e-8 runs two tp=4 engine replicas sharing
+the host — the single-process analog of running two model-server pods
+(which remains the cross-host scaling story; SURVEY.md §2.3 DP row).
+Small models leave chips idle under pure TP (tp is capped by the KV-head
+count — a Qwen2-0.5B with 2 KV heads can use at most tp=2 of 8 chips);
+dp groups put the rest to work on independent traffic.
+
+Routing is least-loaded (running+waiting) at admission; a request never
+migrates. KV prefix caches are per-replica, so a shared RAG prefix warms
+each group once — the same trade a multi-pod deployment makes.
+
+Duck-types AsyncEngine for OpenAIServer: start/stop/stream/generate/
+cancel/stats.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, AsyncIterator
+
+from githubrepostorag_tpu.serving.async_engine import AsyncEngine, StreamEvent
+from githubrepostorag_tpu.serving.engine import Engine, GenerationResult
+from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def dp_submeshes(plan, devices=None):
+    """Split ``devices`` into ``plan.dp`` disjoint groups and build one
+    per-group Mesh with the non-dp axes of ``plan`` (tp/sp/ep; pp is
+    rejected by the serving entrypoint).  Group i gets the i-th contiguous
+    block of devices, matching the dp-major device order make_mesh would
+    use for the full mesh — on a real pod, contiguous blocks are the
+    ICI-adjacent ones, so each replica's tp collectives stay on-ring."""
+    import dataclasses
+
+    import jax
+
+    from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    group_plan = dataclasses.replace(plan, dp=1)
+    per = group_plan.n_devices
+    if plan.dp * per > len(devices):
+        raise ValueError(
+            f"mesh plan {plan.shape()} needs {plan.dp * per} devices, "
+            f"only {len(devices)} available"
+        )
+    groups = [devices[i * per : (i + 1) * per] for i in range(plan.dp)]
+    # even a 1-device group gets a real mesh: Engine only device_puts
+    # params/pools when a mesh is present, so returning None here would
+    # silently stack every replica on the default device
+    return [make_mesh(group_plan, devices=g) for g in groups], groups
+
+
+class MultiAsyncEngine:
+    """AsyncEngine facade over dp engine replicas."""
+
+    def __init__(self, engines: list[Engine]) -> None:
+        if not engines:
+            raise ValueError("need at least one engine")
+        self._engines = [AsyncEngine(e) for e in engines]
+        self._route: dict[str, AsyncEngine] = {}
+        self._ids = itertools.count()
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        for eng in self._engines:
+            await eng.start()
+
+    async def stop(self) -> None:
+        for eng in self._engines:
+            await eng.stop()
+
+    # ------------------------------------------------------------- serving
+
+    def _pick(self) -> AsyncEngine:
+        """Least-loaded admission (running + waiting are host-side ints)."""
+        return min(
+            self._engines,
+            key=lambda ae: ae.engine.num_running + ae.engine.num_waiting,
+        )
+
+    async def stream(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None = None,
+        request_id: str | None = None,
+    ) -> AsyncIterator[StreamEvent]:
+        # engines generate per-engine "req-N" ids that would collide across
+        # replicas; mint a process-unique id when the caller didn't
+        rid = request_id or f"mreq-{next(self._ids)}"
+        target = self._pick()
+        self._route[rid] = target
+        try:
+            async for event in target.stream(prompt_ids, sampling, request_id=rid):
+                yield event
+        finally:
+            self._route.pop(rid, None)
+
+    async def generate(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None = None,
+        request_id: str | None = None,
+    ) -> GenerationResult:
+        async for event in self.stream(prompt_ids, sampling, request_id):
+            if event.type == "final":
+                return event.result
+        raise RuntimeError("stream ended without a final event")  # pragma: no cover
+
+    async def cancel(self, request_id: str) -> None:
+        target = self._route.get(request_id)
+        if target is not None:
+            await target.cancel(request_id)
+
+    def stats(self) -> dict[str, Any]:
+        per = [eng.stats() for eng in self._engines]
+        merged: dict[str, Any] = {
+            key: sum(s[key] for s in per) for key in per[0]
+        }
+        merged["replicas"] = len(per)
+        merged["per_replica"] = per
+        return merged

@@ -24,8 +24,10 @@ axis sharded over the mesh); a GPipe schedule runs inside ONE
 The reference has nothing to mirror (single GPU — SURVEY.md §2.3 lists
 PP as "No"); SURVEY required the mesh to be designed so PP can slot in,
 and this is the slot filled.  Pipeline-parallelism composes with dp for
-the batch dim; tp/sp composition inside a stage is future work (the specs
-exist in parallel/sharding.py).
+the batch dim AND tp inside each stage (Megatron column/row weight shards
+with explicit ``lax.psum`` after the row-parallel products — annotations
+don't propagate into shard_map bodies, so the tp collectives are written
+out; see ``pp_layer_specs``).  sp-in-stage is future work.
 """
 
 from __future__ import annotations
@@ -48,6 +50,28 @@ from githubrepostorag_tpu.models.quant import embedding_lookup
 from githubrepostorag_tpu.ops.attention import dense_attention
 from githubrepostorag_tpu.ops.norms import rms_norm
 from githubrepostorag_tpu.ops.rope import rope_cos_sin
+
+
+def pp_layer_specs(tp: int):
+    """PartitionSpecs for the [pp, L/pp, ...]-staged layer dict.  tp==1:
+    one prefix spec (stage axis only).  tp>1: Megatron column/row shards —
+    wq/wk/wv/wg/wu (+ qkv biases) on their output axis, wo/wd on their
+    input axis — the shard_map-side mirror of
+    parallel/sharding.py::qwen2_param_specs."""
+    if tp <= 1:
+        return P("pp")
+    col_lin = P("pp", None, None, "tp")
+    col_bias = P("pp", None, "tp")
+    row_lin = P("pp", None, "tp", None)
+    return {
+        "ln1": P("pp"), "ln2": P("pp"),
+        "wq": col_lin, "bq": col_bias,
+        "wk": col_lin, "bk": col_bias,
+        "wv": col_lin, "bv": col_bias,
+        "wo": row_lin,
+        "wg": col_lin, "wu": col_lin,
+        "wd": row_lin,
+    }
 
 
 def split_layers_for_pp(params: dict, pp: int) -> dict:
@@ -89,19 +113,36 @@ def make_pp_train_step(
     optimizer = optimizer or optax.adamw(1e-4)
     pp = mesh.shape["pp"]
     dp = mesh.shape.get("dp", 1)
+    tp = mesh.shape.get("tp", 1)
     M = num_microbatches
     if pp < 2:
         raise ValueError("make_pp_train_step needs a pp>=2 mesh axis")
-    for axis in ("tp", "sp"):
-        if mesh.shape.get(axis, 1) != 1:
-            raise ValueError(f"pp step composes with dp only (got {axis}>1)")
+    if mesh.shape.get("sp", 1) != 1:
+        raise ValueError("pp step composes with dp and tp (got sp>1)")
+    if tp > 1:
+        if cfg.num_experts > 0:
+            raise ValueError("tp-in-stage does not cover MoE layers")
+        if cfg.num_heads % tp or cfg.num_kv_heads % tp or cfg.intermediate_size % tp:
+            raise ValueError(
+                f"tp={tp} must divide num_heads={cfg.num_heads}, "
+                f"num_kv_heads={cfg.num_kv_heads}, and "
+                f"intermediate_size={cfg.intermediate_size}"
+            )
+    import dataclasses
+
+    # inside the shard_map body each tp member holds 1/tp of the heads and
+    # the MLP width; _block reshapes by these LOCAL counts
+    cfg_local = dataclasses.replace(
+        cfg, num_heads=cfg.num_heads // tp, num_kv_heads=cfg.num_kv_heads // tp
+    ) if tp > 1 else cfg
 
     n_ticks = M + pp - 1
     mb_spec = P(None, "dp") if dp > 1 else P()  # [M, B/M, S]: batch over dp
 
     def pp_loss(layers_local, embed, norm, lm_head, ids, targets, mask):
-        """shard_map body.  layers_local: [1, L/pp, ...] this stage's slice;
-        ids/targets/mask: [M, mb, S] microbatches (replicated over pp)."""
+        """shard_map body.  layers_local: [1, L/pp, ...] this stage's slice
+        (weights additionally 1/tp-sharded column/row-wise when tp>1);
+        ids/targets/mask: [M, mb, S] microbatches (replicated over pp/tp)."""
         layers_local = jax.tree.map(lambda x: x[0], layers_local)  # [L/pp,...]
         p_idx = lax.axis_index("pp")
         last = pp - 1
@@ -115,11 +156,14 @@ def make_pp_train_step(
         attend = lambda q, k, v: (
             dense_attention(q, k, v, causal=True, q_offset=0), None
         )
+        # Megatron TP inside the stage: column shards compute local heads /
+        # MLP width, the row-parallel products psum back to replicated
+        reduce = (lambda x: lax.psum(x, "tp")) if tp > 1 else None
 
         def run_stage(x):
             def layer_body(h, xs):
                 (pl,) = xs
-                h, _ = _block(cfg, h, pl, cos, sin, attend)
+                h, _ = _block(cfg_local, h, pl, cos, sin, attend, reduce=reduce)
                 return h, None
 
             if remat:
@@ -168,12 +212,13 @@ def make_pp_train_step(
             tok_sum = lax.psum(tok_sum, "dp")
         return loss_sum / jnp.maximum(tok_sum, 1.0)
 
-    # layers: leading (stage) axis over pp; head params replicated;
-    # microbatches replicated over pp, batch-dim over dp
+    # layers: leading (stage) axis over pp, plus Megatron column/row tp
+    # shards when tp>1; head params replicated; microbatches replicated
+    # over pp/tp, batch-dim over dp
     shard_body = jax.shard_map(
         pp_loss,
         mesh=mesh,
-        in_specs=(P("pp"), P(), P(), P(), mb_spec, mb_spec, mb_spec),
+        in_specs=(pp_layer_specs(tp), P(), P(), P(), mb_spec, mb_spec, mb_spec),
         out_specs=P(),
         check_vma=False,
     )
@@ -223,12 +268,23 @@ def init_pp_train_state(
     from githubrepostorag_tpu.training.step import TrainState
 
     pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
     params = split_layers_for_pp(init_params(cfg, key, dtype=dtype), pp)
-    staged = NamedSharding(mesh, P("pp"))
+    specs = pp_layer_specs(tp)
     replicated = NamedSharding(mesh, P())
+
+    def place_layers(layers: dict) -> dict:
+        if isinstance(specs, P):  # tp==1: one prefix spec for every leaf
+            return jax.tree.map(
+                lambda x: jax.device_put(x, NamedSharding(mesh, specs)), layers
+            )
+        return {
+            k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in layers.items()
+        }
+
     params = {
-        k: jax.tree.map(lambda x: jax.device_put(x, staged), v)
-        if k == "layers"
+        k: place_layers(v) if k == "layers"
         else jax.tree.map(lambda x: jax.device_put(x, replicated), v)
         for k, v in params.items()
     }
