@@ -79,37 +79,40 @@ def sample_tokens_capped(
 ) -> jnp.ndarray:
     """Decode-loop sampler: identical semantics to ``sample_tokens`` except
     top-k/top-p operate within the ``cap`` highest logits.  The candidate
-    set comes from a two-stage reduction: ``lax.approx_max_k`` pulls a
-    2*cap-candidate pool (TPU-native; an exact ``lax.top_k`` over the 152k
-    vocab measures ~1.6 ms/step standalone on v5e — comparable to the whole
-    0.5B forward — and costs ~15% of decode throughput in-burst), then an
-    exact ``lax.top_k`` ranks the final cap within that pool.  approx's
-    bin-collision misses are spread over its k-set, so oversampling 2x
-    roughly halves the chance (~(1-recall)/2 per step) that any top-cap
-    token is missing, and the returned values are exact, so ranking within
-    the pool is exact.  A missed token costs one step of sampling mass —
-    no correctness impact, greedy rows use the separate exact argmax below.
+    set comes from one ``lax.approx_max_k`` (TPU-native; an exact
+    ``lax.top_k`` over the 152k vocab measures ~1.6 ms/step standalone on
+    v5e — comparable to the whole 0.5B forward — and costs ~15% of decode
+    throughput in-burst) whose default aggregate_to_topk pass already
+    returns the cap candidates EXACTLY sorted; recall_target=0.99 sets the
+    internal bin oversampling.  A bin-collision miss (~(1-recall) per
+    step) costs one step of that token's sampling mass — no correctness
+    impact, greedy rows use the separate exact argmax below.
     Exact nucleus whenever it fits the cap, which holds for every sampling
     config in the system (reference clients use top_p 0.8/0.9 at
     temperature <= 0.7 — qwen_llm.py:107-114).
 
     SAMPLING_EXACT_TOPK=1 swaps the approximate candidate pull for an
     exact ``lax.top_k`` over the full vocab — the escape hatch for
-    reproducibility-sensitive evals where the ~(1-recall)/2-per-step
-    chance of a missing tail candidate matters more than the ~15%
+    reproducibility-sensitive evals where the ~(1-recall)-per-step chance
+    of a missing tail candidate matters more than the ~15%
     decode-throughput cost."""
     logits = apply_repetition_penalty(logits, presence, repetition_penalty[:, None])
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     vocab = logits.shape[-1]
-    pool = min(2 * cap, vocab)
+    cap = min(cap, vocab)
     if _exact_topk():
-        pool_vals, pool_idx = jax.lax.top_k(scaled, pool)
+        vals, idx = jax.lax.top_k(scaled, cap)
+        idx = idx.astype(jnp.int32)
     else:
-        pool_vals, pool_idx = jax.lax.approx_max_k(scaled, pool, recall_target=0.99)
-    vals, within = jax.lax.top_k(pool_vals, cap)  # exact rank inside the pool
-    idx = jnp.take_along_axis(pool_idx, within, axis=-1).astype(jnp.int32)
+        # approx_max_k's default aggregate_to_topk=True ENDS with an exact
+        # sorted top-cap over its oversampled candidate bins (the recall
+        # knob controls the internal oversampling), so its output is
+        # already what a second lax.top_k would produce — device profiling
+        # showed that redundant second sort costing ~0.1 ms/decode step
+        vals, idx = jax.lax.approx_max_k(scaled, cap, recall_target=0.99)
+        idx = idx.astype(jnp.int32)
     # top-k within the cap: positions >= k masked (k<=0 disables)
     ranks = jnp.arange(cap)[None, :]
     k_arr = top_k[:, None]

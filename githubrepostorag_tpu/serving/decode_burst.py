@@ -59,23 +59,25 @@ def _staged_attend_tp(mesh, interpret):
     around it and inserts the row-parallel psums after wo/wd."""
     from jax.experimental.shard_map import shard_map
 
-    def call(q, kp, vp, bt, pool_lens, sk, sv, staged_len):
+    def call(q, kp, vp, bt, pool_lens, sk, sv, staged_len, layer):
         return paged_attention_decode_staged(
-            q, kp, vp, bt, pool_lens, sk, sv, staged_len, interpret=interpret
+            q, kp, vp, bt, pool_lens, sk, sv, staged_len, layer,
+            interpret=interpret,
         )
 
     return shard_map(
         call,
         mesh=mesh,
         in_specs=(
-            P(None, None, "tp", None),   # q over heads
-            P("tp", None, None, None),   # k_pages over kv heads
-            P("tp", None, None, None),   # v_pages
-            P(None, None),               # block tables replicated
-            P(None),                     # pool lens replicated
-            P(None, "tp", None, None),   # staged k over kv heads
-            P(None, "tp", None, None),   # staged v
-            P(None),                     # staged_len replicated
+            P(None, None, "tp", None),        # q over heads
+            P(None, "tp", None, None, None),  # [L, n_kv, P, ps, hd] pools
+            P(None, "tp", None, None, None),  # over kv heads
+            P(None, None),                    # block tables replicated
+            P(None),                          # pool lens replicated
+            P(None, "tp", None, None),        # staged k over kv heads
+            P(None, "tp", None, None),        # staged v
+            P(None),                          # staged_len replicated
+            P(None),                          # layer index replicated
         ),
         out_specs=P(None, None, "tp", None),
         check_rep=False,
@@ -142,61 +144,87 @@ def decode_burst(
         )  # [B, 1, d]
         cos, sin = rope_cos_sin(lens[:, None], hd, cfg.rope_theta)
 
-        def attend_for(kp, vp, sk, sv):
-            def stage(sk, sv, k_new, v_new):
-                """Write this step's K/V at staged position ``step``.
-                sk/sv: [B, n_kv, n_steps, hd]; k_new/v_new: [B, 1, n_kv, hd]."""
-                k_t = k_new.swapaxes(1, 2).astype(kv_dtype)  # [B, n_kv, 1, hd]
-                v_t = v_new.swapaxes(1, 2).astype(kv_dtype)
-                write = lambda s, new: jax.lax.dynamic_update_slice(
-                    s, new, (0, step, 0)
-                )
-                return jax.vmap(write)(sk, k_t), jax.vmap(write)(sv, v_t)
+        # The FULL [L, ...] staged buffers ride the layer scan as CARRY;
+        # each layer writes its [B, n_kv, 1, hd] slab at (li, :, :, step).
+        # Making them scan xs/ys instead (the r02 layout) restacks the
+        # whole ~2x50 MB at every step — slicing each layer in and
+        # collecting each layer out — pure HBM traffic the carry+indexed
+        # write avoids.
+        def stage_at(sk_all, sv_all, li, k_new, v_new):
+            """k_new/v_new: [B, 1, n_kv, hd] -> write at [li, :, :, step]."""
+            k_t = k_new.swapaxes(1, 2).astype(kv_dtype)[None, :, :, :]
+            v_t = v_new.swapaxes(1, 2).astype(kv_dtype)[None, :, :, :]
+            sk_all = jax.lax.dynamic_update_slice(sk_all, k_t, (li, 0, 0, step, 0))
+            sv_all = jax.lax.dynamic_update_slice(sv_all, v_t, (li, 0, 0, step, 0))
+            return sk_all, sv_all
 
-            if use_pallas:
-                interpret = jax.default_backend() != "tpu"
-                if mesh is not None and mesh.shape.get("tp", 1) > 1:
-                    kernel = _staged_attend_tp(mesh, interpret)
-                else:
-                    kernel = partial(paged_attention_decode_staged, interpret=interpret)
+        if use_pallas:
+            interpret = jax.default_backend() != "tpu"
+            if mesh is not None and mesh.shape.get("tp", 1) > 1:
+                kernel = _staged_attend_tp(mesh, interpret)
+            else:
+                kernel = partial(paged_attention_decode_staged, interpret=interpret)
 
+            # full rank-5 pools go straight into the kernel with the layer
+            # index as a prefetched scalar — pools are NOT layer-scan xs,
+            # so no [n_kv, P, ps, hd] slice is ever materialized (profiled
+            # at ~0.5 ms/step of copy traffic in the sliced form)
+            def make_attend(kp, vp, li, sk_all, sv_all):
                 def attend(q, k_new, v_new):
-                    sk2, sv2 = stage(sk, sv, k_new, v_new)
+                    sk2, sv2 = stage_at(sk_all, sv_all, li, k_new, v_new)
                     out = kernel(
-                        q, kp, vp, block_tables, start_lens, sk2, sv2,
+                        q, kp, vp, block_tables, start_lens,
+                        jax.lax.dynamic_index_in_dim(sk2, li, 0, keepdims=False),
+                        jax.lax.dynamic_index_in_dim(sv2, li, 0, keepdims=False),
                         jnp.reshape(step + 1, (1,)),
+                        jnp.reshape(li, (1,)),
                     )
                     return out, (sk2, sv2)
 
                 return attend
-
-            pool_k, pool_v = gather_kv(kp, vp, block_tables)  # [B, mp*ps, n_kv, hd]
-            pool_valid = (
-                jnp.arange(pool_k.shape[1])[None, :] < start_lens[:, None]
-            )
+        else:
             # staged positions are valid up to and including this step (the
             # new token attends itself)
             staged_valid = (staged_idx <= step)[None, :]  # [1, n_steps]
 
-            def attend(q, k_new, v_new):
-                sk2, sv2 = stage(sk, sv, k_new, v_new)
-                k_all = jnp.concatenate([pool_k, sk2.swapaxes(1, 2)], axis=1)
-                v_all = jnp.concatenate([pool_v, sv2.swapaxes(1, 2)], axis=1)
-                valid = jnp.concatenate(
-                    [pool_valid, jnp.broadcast_to(staged_valid, (b, n_steps))], axis=1
+            def make_attend(kp, vp, li, sk_all, sv_all):
+                pool_k, pool_v = gather_kv(kp, vp, block_tables)  # [B, mp*ps, n_kv, hd]
+                pool_valid = (
+                    jnp.arange(pool_k.shape[1])[None, :] < start_lens[:, None]
                 )
-                out = dense_attention(q, k_all, v_all, causal=False, kv_valid=valid)
-                return out, (sk2, sv2)
 
-            return attend
+                def attend(q, k_new, v_new):
+                    sk2, sv2 = stage_at(sk_all, sv_all, li, k_new, v_new)
+                    sk = jax.lax.dynamic_index_in_dim(sk2, li, 0, keepdims=False)
+                    sv = jax.lax.dynamic_index_in_dim(sv2, li, 0, keepdims=False)
+                    k_all = jnp.concatenate([pool_k, sk.swapaxes(1, 2)], axis=1)
+                    v_all = jnp.concatenate([pool_v, sv.swapaxes(1, 2)], axis=1)
+                    valid = jnp.concatenate(
+                        [pool_valid, jnp.broadcast_to(staged_valid, (b, n_steps))],
+                        axis=1,
+                    )
+                    out = dense_attention(q, k_all, v_all, causal=False, kv_valid=valid)
+                    return out, (sk2, sv2)
 
-        def layer_body(h, layer_xs):
-            p, kp, vp, sk, sv = layer_xs
-            h, (sk, sv) = _block(cfg, h, p, cos, sin, attend_for(kp, vp, sk, sv))
-            return h, (sk, sv)
+                return attend
 
-        h, (staged_k, staged_v) = jax.lax.scan(
-            layer_body, h, (params["layers"], k_pages, v_pages, staged_k, staged_v)
+        if use_pallas:
+            # pools captured whole (rank-5 into the kernel), NOT sliced xs
+            layer_xs = (params["layers"],)
+        else:
+            layer_xs = (params["layers"], k_pages, v_pages)
+
+        def layer_body(lcarry, xs):
+            h, sk_all, sv_all, li = lcarry
+            # pallas: loop-invariant full pools; fallback: per-layer slices
+            p, kp, vp = xs if len(xs) == 3 else (xs[0], k_pages, v_pages)
+            h, (sk_all, sv_all) = _block(
+                cfg, h, p, cos, sin, make_attend(kp, vp, li, sk_all, sv_all)
+            )
+            return (h, sk_all, sv_all, li + 1), None
+
+        (h, staged_k, staged_v, _), _ = jax.lax.scan(
+            layer_body, (h, staged_k, staged_v, 0), layer_xs,
         )
         logits = _logits(params, h)
 
