@@ -433,6 +433,24 @@ def _main() -> None:
         del eng
         gc.collect()
 
+    # ---- int8 KV cache: same 64-stream config over quantized pages -------
+    # (VERDICT r02 #5: halved KV reads + doubled page capacity; the delta
+    # vs the bf16-KV line above is the cost/benefit at this context length)
+    if budget_allows("concurrent64-kvq", 180):
+        engq = Engine(params05, cfg05, max_num_seqs=64, num_pages=320,
+                      page_size=64, max_seq_len=1024, prefill_chunk=256,
+                      use_pallas=True, decode_burst=32, kv_quant=True)
+        log("bench[64seq-kvquant]: warmup (compiles all row buckets)")
+        engq.warmup()
+        aggq, p50q = bench_concurrency(cfg05, streams=64, prompt_len=128,
+                                       gen_tokens=128, engine=engq)
+        emit("concurrent64_agg_tok_s_qwen2-0.5b_kvquant_int8", aggq, "tok/s",
+             aggq / BASELINE_TOK_S)
+        emit("concurrent64_p50_ttft_qwen2-0.5b_kvquant_int8", p50q, "s",
+             BASELINE_TTFT_S / max(p50q, 1e-9))
+        del engq
+        gc.collect()
+
     # ---- speculative decoding in its acceptance regime -------------------
     if budget_allows("spec-decode", 150):
         tpd, acc, spec_wall, burst_wall = bench_spec_decode(params05, cfg05)

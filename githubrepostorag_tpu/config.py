@@ -154,6 +154,9 @@ class Settings:
     # knob for quoting-heavy greedy decodes, 0 (bursts) is the throughput
     # default
     spec_ngram_k: int = field(default_factory=lambda: _env_int("SPEC_NGRAM_K", 0))
+    # int8 KV cache pages with per-token dequant scales: halves KV reads
+    # and doubles effective page capacity (serving/kv_cache.py quantize_kv)
+    kv_quant: bool = field(default_factory=lambda: _env_bool("KV_QUANT", False))
     # MoE serving expert capacity = ceil(K*T/E * factor); overflow
     # assignments drop that expert's contribution (models/moe.py; set
     # MOE_DROP_STATS=1 to count drops).  0 = exact no-drop dispatch —

@@ -369,13 +369,23 @@ def forward_paged(
     new_lens: jnp.ndarray,  # [B] valid new tokens this step
     use_pallas: bool = False,
     logits_at: jnp.ndarray | None = None,  # [B] per-row position, see below
+    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P, page_size] f32 —
+    v_scales: jnp.ndarray | None = None,  # int8 (kv_quant) pool scales
 ):
     """Prefill-chunk or decode step over the paged KV cache.
 
     New K/V are scattered into the page pools at ``slot_mapping`` (padding
     slots are -1 and dropped), then attention runs over each row's block
-    table.  Returns (logits, k_pages, v_pages) — the pools are donated so
-    XLA updates them in place.
+    table.  Returns (logits, k_pages, v_pages[, k_scales, v_scales]) — the
+    pools are donated so XLA updates them in place (scale pools are small
+    enough that their copy is noise).
+
+    ``k_scales``/``v_scales`` mark int8 kv_quant pools: new K/V quantize
+    per token vector at the scatter (kv_cache.quantize_kv) and attention
+    runs the gather path with dequant — prefill/verify chunks are
+    compute-dominated, so the materialized gather costs little here; the
+    decode hot path (decode_burst) reads int8 pages directly in its
+    Pallas kernel.
 
     ``logits_at``: per-row chunk index at which to project logits, returning
     [B, 1, V].  Without it logits cover every position ([B, S, V] float32 —
@@ -386,7 +396,7 @@ def forward_paged(
     return forward_paged_impl(
         params, cfg, input_ids, positions, k_pages, v_pages,
         slot_mapping, block_tables, cached_lens, new_lens, use_pallas,
-        logits_at=logits_at,
+        logits_at=logits_at, k_scales=k_scales, v_scales=v_scales,
     )
 
 
@@ -403,13 +413,16 @@ def forward_paged_impl(
     new_lens: jnp.ndarray,
     use_pallas: bool = False,
     logits_at: jnp.ndarray | None = None,
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
 ):
     """Unjitted body of ``forward_paged`` so larger fused programs (the
     multi-step decode burst in serving/decode_burst.py) can inline it inside
     their own scan without nested-jit donation clashes."""
     from githubrepostorag_tpu.ops.paged_attention import paged_attention_ref
 
-    if use_pallas:
+    quant = k_scales is not None
+    if use_pallas and not quant:
         from githubrepostorag_tpu.ops.pallas_paged import paged_attention as attn_fn
     else:
         attn_fn = paged_attention_ref
@@ -428,25 +441,57 @@ def forward_paged_impl(
     flat_slots = jnp.where(flat_slots < 0, total_slots, flat_slots)
 
     def body(h, layer_xs):
-        p, kp, vp = layer_xs
+        if quant:
+            p, kp, vp, ks, vs = layer_xs
+        else:
+            p, kp, vp = layer_xs
+            ks = vs = None
 
         def attend(q, k, v):
             # [n_kv, P*ps, hd] flat view; one slot vector shared by all heads
             kp_flat = kp.reshape(nkv, total_slots, hd)
             vp_flat = vp.reshape(nkv, total_slots, hd)
-            k_t = k.reshape(-1, nkv, hd).swapaxes(0, 1).astype(kp.dtype)  # [n_kv, B*S, hd]
-            v_t = v.reshape(-1, nkv, hd).swapaxes(0, 1).astype(vp.dtype)
+            k_t = k.reshape(-1, nkv, hd).swapaxes(0, 1)  # [n_kv, B*S, hd]
+            v_t = v.reshape(-1, nkv, hd).swapaxes(0, 1)
+            if quant:
+                from githubrepostorag_tpu.serving.kv_cache import quantize_kv
+
+                k_t, k_s = quantize_kv(k_t)
+                v_t, v_s = quantize_kv(v_t)
+                ks_flat = ks.reshape(nkv, total_slots)
+                vs_flat = vs.reshape(nkv, total_slots)
+                ks_flat = ks_flat.at[:, flat_slots].set(k_s, mode="drop")
+                vs_flat = vs_flat.at[:, flat_slots].set(v_s, mode="drop")
+                new_ks = ks_flat.reshape(nkv, num_pages, page_size)
+                new_vs = vs_flat.reshape(nkv, num_pages, page_size)
+            else:
+                k_t = k_t.astype(kp.dtype)
+                v_t = v_t.astype(vp.dtype)
+                new_ks = new_vs = None
             kp_flat = kp_flat.at[:, flat_slots].set(k_t, mode="drop")
             vp_flat = vp_flat.at[:, flat_slots].set(v_t, mode="drop")
             new_kp = kp_flat.reshape(nkv, num_pages, page_size, hd)
             new_vp = vp_flat.reshape(nkv, num_pages, page_size, hd)
+            if quant:
+                attn = attn_fn(q, new_kp, new_vp, block_tables, cached_lens,
+                               new_lens, new_ks, new_vs)
+                return attn, (new_kp, new_vp, new_ks, new_vs)
             attn = attn_fn(q, new_kp, new_vp, block_tables, cached_lens, new_lens)
             return attn, (new_kp, new_vp)
 
         return _block(cfg, h, p, cos, sin, attend)
 
-    h, (k_pages, v_pages) = jax.lax.scan(body, h, (params["layers"], k_pages, v_pages))
+    if quant:
+        xs = (params["layers"], k_pages, v_pages, k_scales, v_scales)
+        h, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(body, h, xs)
+    else:
+        h, (k_pages, v_pages) = jax.lax.scan(
+            body, h, (params["layers"], k_pages, v_pages)
+        )
     h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
     if logits_at is not None:
         h = jnp.take_along_axis(h, logits_at[:, None, None], axis=1)  # [B, 1, d]
-    return _logits(params, h), k_pages, v_pages
+    logits = _logits(params, h)
+    if quant:
+        return logits, k_pages, v_pages, k_scales, v_scales
+    return logits, k_pages, v_pages

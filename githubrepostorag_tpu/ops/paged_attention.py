@@ -27,21 +27,33 @@ import jax.numpy as jnp
 from githubrepostorag_tpu.ops.attention import dense_attention
 
 
-def gather_kv(k_pages, v_pages, block_tables):
-    """[n_kv, P, ps, hd] + [B, max_pages] -> [B, max_pages*ps, n_kv, hd]."""
+def gather_kv(k_pages, v_pages, block_tables, k_scales=None, v_scales=None,
+              dtype=None):
+    """[n_kv, P, ps, hd] + [B, max_pages] -> [B, max_pages*ps, n_kv, hd].
+
+    With ``k_scales``/``v_scales`` ([n_kv, P, ps] per-token dequant scales,
+    kv_quant pools) the gathered int8 pages dequantize to ``dtype``
+    (default bf16) on the way out."""
     b, max_pages = block_tables.shape
     n_kv, _, ps, hd = k_pages.shape
 
-    def gather(pages):
+    def gather(pages, scales):
         g = pages[:, block_tables]  # [n_kv, B, max_pages, ps, hd]
         g = jnp.moveaxis(g, 0, 3)  # [B, max_pages, ps, n_kv, hd]
-        return g.reshape(b, max_pages * ps, n_kv, hd)
+        g = g.reshape(b, max_pages * ps, n_kv, hd)
+        if scales is None:
+            return g
+        s = jnp.moveaxis(scales[:, block_tables], 0, 3)  # [B, mp, ps, n_kv]
+        s = s.reshape(b, max_pages * ps, n_kv)
+        return (g.astype(jnp.float32) * s[..., None]).astype(dtype or jnp.bfloat16)
 
-    return gather(k_pages), gather(v_pages)
+    return gather(k_pages, k_scales), gather(v_pages, v_scales)
 
 
-def paged_attention_ref(q, k_pages, v_pages, block_tables, cached_lens, new_lens):
-    k, v = gather_kv(k_pages, v_pages, block_tables)
+def paged_attention_ref(q, k_pages, v_pages, block_tables, cached_lens, new_lens,
+                        k_scales=None, v_scales=None):
+    k, v = gather_kv(k_pages, v_pages, block_tables, k_scales, v_scales,
+                     dtype=q.dtype)
     # The new tokens are already scattered into the pages before attention,
     # so the valid kv length is cached + new.
     return dense_attention(

@@ -162,6 +162,7 @@ def _decode_staged_kernel(
     page_size: int,
     scale: float,
     layered: bool = False,
+    kv_quant: bool = False,
 ):
     """Decode-burst attention: online softmax over [pool-prefix pages |
     staged tail].  Grid (B, max_pages + 1): the first max_pages steps walk
@@ -175,20 +176,36 @@ def _decode_staged_kernel(
     pool_lens (B), staged_len (1), + layer (1) when ``layered``], blocks
     [q (1, n_kv, group, hd) VMEM, k/v (one pool page, every kv head —
     leading extra 1 for the layer axis when ``layered``), staged k/v
-    (1, n_kv, n_steps, hd)], out (1, n_kv, group, hd), scratch [m, l
-    (n_kv, group, 128) f32, acc (n_kv, group, hd) f32]."""
+    (1, n_kv, n_steps, hd), + k/v scale tiles when ``kv_quant``], out
+    (1, n_kv, group, hd), scratch [m, l (n_kv, group, 128) f32, acc
+    (n_kv, group, hd) f32].  ``kv_quant``: pool tiles are int8 with
+    per-token scales ([.., page_size] tiles riding the same page index
+    map); dequant happens here in VMEM, right before the dots."""
+    n_scalars = 4 if layered else 3
+    n_blocks = 7 if kv_quant else 5
+    scalar_refs = refs[:n_scalars]
+    block_tables_ref, pool_lens_ref, staged_len_ref = scalar_refs[:3]
+    blocks = refs[n_scalars : n_scalars + n_blocks]
+    q_ref, k_ref, v_ref, sk_ref, sv_ref = blocks[:5]
+    out_ref, m_ref, l_ref, acc_ref = refs[n_scalars + n_blocks :]
     if layered:
-        (block_tables_ref, pool_lens_ref, staged_len_ref, _layer_ref,
-         q_ref, k_ref, v_ref, sk_ref, sv_ref, out_ref,
-         m_ref, l_ref, acc_ref) = refs
-        k_page = lambda: k_ref[0, :, 0]  # [n_kv, page_size, hd]
-        v_page = lambda: v_ref[0, :, 0]
+        raw_k = lambda: k_ref[0, :, 0]  # [n_kv, page_size, hd]
+        raw_v = lambda: v_ref[0, :, 0]
+        page_scale = lambda ref: ref[0, :, 0]  # [n_kv, page_size]
     else:
-        (block_tables_ref, pool_lens_ref, staged_len_ref,
-         q_ref, k_ref, v_ref, sk_ref, sv_ref, out_ref,
-         m_ref, l_ref, acc_ref) = refs
-        k_page = lambda: k_ref[:, 0]
-        v_page = lambda: v_ref[:, 0]
+        raw_k = lambda: k_ref[:, 0]
+        raw_v = lambda: v_ref[:, 0]
+        page_scale = lambda ref: ref[:, 0]
+    if kv_quant:
+        ks_ref, vs_ref = blocks[5:]
+        k_page = lambda: (
+            raw_k().astype(jnp.float32) * page_scale(ks_ref)[..., None]
+        )
+        v_page = lambda: (
+            raw_v().astype(jnp.float32) * page_scale(vs_ref)[..., None]
+        )
+    else:
+        k_page, v_page = raw_k, raw_v
     bi = pl.program_id(0)
     pi = pl.program_id(1)
     num_pi = pl.num_programs(1)
@@ -257,6 +274,8 @@ def paged_attention_decode_staged(
     staged_v: jnp.ndarray,
     staged_len: jnp.ndarray,  # [1] int32 — staged entries valid this step
     layer: jnp.ndarray | None = None,  # [] / [1] int32, REQUIRED for rank-5
+    k_scales: jnp.ndarray | None = None,  # pool dequant scales (int8 pools):
+    v_scales: jnp.ndarray | None = None,  # [(L,) n_kv, P, ps] f32
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Burst-decode attention over [pool prefix | staged tail] without ever
@@ -269,10 +288,16 @@ def paged_attention_decode_staged(
     scalar — the BlockSpec index map addresses (layer, head, page)
     directly, so no per-layer pool slice is ever materialized.  Device
     profiling showed the sliced form costing ~0.5 ms/step at 0.5B/bs8
-    (2 x 4 MB x 24 layers of dynamic-slice copy traffic per decode step)."""
+    (2 x 4 MB x 24 layers of dynamic-slice copy traffic per decode step).
+
+    ``k_scales``/``v_scales`` mark int8 (kv_quant) pools: each page tile
+    arrives int8 with a per-token scale tile riding the same index map,
+    and dequant happens in VMEM right before the dots — KV HBM reads
+    halve; the staged tail stays full precision."""
     b, s, n_q, hd = q.shape
     assert s == 1, "staged kernel is the decode path (S == 1)"
     layered = k_pages.ndim == 5
+    kv_quant = k_scales is not None
     if layered:
         assert layer is not None, "rank-5 pools need the layer index"
         n_kv, num_pages, page_size, _ = k_pages.shape[1:]
@@ -297,15 +322,23 @@ def paged_attention_decode_staged(
         )
 
     if layered:
-        def kv_map(bi, pi, bt, pool, sl, li):
-            return (li[0], 0, clamp_page(bi, pi, bt, pool), 0, 0)
+        def kv_map(bi, pi, bt, pool, sl, *rest):
+            return (rest[0][0], 0, clamp_page(bi, pi, bt, pool), 0, 0)
+
+        def scale_map(bi, pi, bt, pool, sl, *rest):
+            return (rest[0][0], 0, clamp_page(bi, pi, bt, pool), 0)
 
         kv_block = (1, n_kv, 1, page_size, hd)
+        scale_block = (1, n_kv, 1, page_size)
     else:
-        def kv_map(bi, pi, bt, pool, sl):
+        def kv_map(bi, pi, bt, pool, sl, *rest):
             return (0, clamp_page(bi, pi, bt, pool), 0, 0)
 
+        def scale_map(bi, pi, bt, pool, sl, *rest):
+            return (0, clamp_page(bi, pi, bt, pool), 0)
+
         kv_block = (n_kv, 1, page_size, hd)
+        scale_block = (n_kv, 1, page_size)
 
     def staged_map(bi, pi, *refs):
         return (bi, 0, 0, 0)
@@ -318,16 +351,21 @@ def paged_attention_decode_staged(
     ]
     if layered:
         scalars.append(jnp.reshape(layer, (1,)).astype(jnp.int32))
+    in_specs = [
+        pl.BlockSpec((1, n_kv, group, hd), q_map),
+        pl.BlockSpec(kv_block, kv_map),
+        pl.BlockSpec(kv_block, kv_map),
+        pl.BlockSpec((1, n_kv, n_steps, hd), staged_map),
+        pl.BlockSpec((1, n_kv, n_steps, hd), staged_map),
+    ]
+    operands = [q_r, k_pages, v_pages, staged_k, staged_v]
+    if kv_quant:
+        in_specs += [pl.BlockSpec(scale_block, scale_map)] * 2
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(scalars),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, n_kv, group, hd), q_map),
-            pl.BlockSpec(kv_block, kv_map),
-            pl.BlockSpec(kv_block, kv_map),
-            pl.BlockSpec((1, n_kv, n_steps, hd), staged_map),
-            pl.BlockSpec((1, n_kv, n_steps, hd), staged_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n_kv, group, hd), q_map),
         scratch_shapes=[
             pltpu.VMEM((n_kv, group, 128), jnp.float32),
@@ -337,7 +375,8 @@ def paged_attention_decode_staged(
     )
 
     kernel = functools.partial(
-        _decode_staged_kernel, page_size=page_size, scale=scale, layered=layered
+        _decode_staged_kernel, page_size=page_size, scale=scale,
+        layered=layered, kv_quant=kv_quant,
     )
     out = pl.pallas_call(
         kernel,
@@ -347,7 +386,7 @@ def paged_attention_decode_staged(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(*scalars, q_r, k_pages, v_pages, staged_k, staged_v)
+    )(*scalars, *operands)
 
     return out.reshape(b, 1, n_q, hd)
 

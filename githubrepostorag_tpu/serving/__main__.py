@@ -95,6 +95,7 @@ async def serve(host: str, port: int) -> None:
             max_seq_len=s.context_window,
             prefill_chunk=s.prefill_chunk,
             use_pallas=jax.default_backend() == "tpu",
+            kv_quant=s.kv_quant,
             mesh=mesh,
             prefix_caching=s.prefix_caching,
             sp_prefill_threshold=s.sp_prefill_threshold or None,

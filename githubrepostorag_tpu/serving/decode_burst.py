@@ -51,34 +51,40 @@ from githubrepostorag_tpu.ops.rope import rope_cos_sin
 from githubrepostorag_tpu.ops.sampling import sample_tokens_capped
 
 
-def _staged_attend_tp(mesh, interpret):
+def _staged_attend_tp(mesh, interpret, quant: bool = False):
     """The Pallas staged kernel wrapped in a shard_map island for tensor
     parallelism: attention is embarrassingly parallel over kv heads, so each
     tp shard runs the kernel on its local heads (q [B,1,nq/tp,hd], pools
     [n_kv/tp,...]) with zero collectives — GSPMD handles the dense program
-    around it and inserts the row-parallel psums after wo/wd."""
+    around it and inserts the row-parallel psums after wo/wd.  ``quant``
+    adds the int8 pools' per-token scale operands (sharded with their
+    pages' kv-head axis)."""
     from jax.experimental.shard_map import shard_map
 
-    def call(q, kp, vp, bt, pool_lens, sk, sv, staged_len, layer):
+    def call(q, kp, vp, bt, pool_lens, sk, sv, staged_len, layer, *scales):
         return paged_attention_decode_staged(
-            q, kp, vp, bt, pool_lens, sk, sv, staged_len, layer,
+            q, kp, vp, bt, pool_lens, sk, sv, staged_len, layer, *scales,
             interpret=interpret,
         )
+
+    in_specs = [
+        P(None, None, "tp", None),        # q over heads
+        P(None, "tp", None, None, None),  # [L, n_kv, P, ps, hd] pools
+        P(None, "tp", None, None, None),  # over kv heads
+        P(None, None),                    # block tables replicated
+        P(None),                          # pool lens replicated
+        P(None, "tp", None, None),        # staged k over kv heads
+        P(None, "tp", None, None),        # staged v
+        P(None),                          # staged_len replicated
+        P(None),                          # layer index replicated
+    ]
+    if quant:
+        in_specs += [P(None, "tp", None, None)] * 2  # [L, n_kv, P, ps] scales
 
     return shard_map(
         call,
         mesh=mesh,
-        in_specs=(
-            P(None, None, "tp", None),        # q over heads
-            P(None, "tp", None, None, None),  # [L, n_kv, P, ps, hd] pools
-            P(None, "tp", None, None, None),  # over kv heads
-            P(None, None),                    # block tables replicated
-            P(None),                          # pool lens replicated
-            P(None, "tp", None, None),        # staged k over kv heads
-            P(None, "tp", None, None),        # staged v
-            P(None),                          # staged_len replicated
-            P(None),                          # layer index replicated
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, None, "tp", None),
         check_rep=False,
     )
@@ -108,6 +114,8 @@ def decode_burst(
     n_steps: int,
     use_pallas: bool = False,
     mesh=None,  # jax.sharding.Mesh with a tp axis -> TP-sharded attention
+    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P, ps] f32: int8
+    v_scales: jnp.ndarray | None = None,  # (kv_quant) pool dequant scales
 ):
     """Run ``n_steps`` decode iterations for every active row.
 
@@ -125,7 +133,11 @@ def decode_burst(
     num_pages, page_size = k_pages.shape[2], k_pages.shape[3]
     rows = jnp.arange(b)
     start_lens = seq_lens  # pool validity is frozen for the whole burst
-    kv_dtype = k_pages.dtype
+    quant = k_scales is not None
+    # staged tail stays full precision even over int8 pools — it is tiny
+    # (MBs) and fresh tokens re-read every step; only the committed pages
+    # carry the int8 + per-token-scale representation
+    kv_dtype = jnp.bfloat16 if quant else k_pages.dtype
 
     staged_shape = (L, b, n_kv, n_steps, hd)
     staged_k0 = jnp.zeros(staged_shape, dtype=kv_dtype)
@@ -161,7 +173,7 @@ def decode_burst(
         if use_pallas:
             interpret = jax.default_backend() != "tpu"
             if mesh is not None and mesh.shape.get("tp", 1) > 1:
-                kernel = _staged_attend_tp(mesh, interpret)
+                kernel = _staged_attend_tp(mesh, interpret, quant=quant)
             else:
                 kernel = partial(paged_attention_decode_staged, interpret=interpret)
 
@@ -178,6 +190,7 @@ def decode_burst(
                         jax.lax.dynamic_index_in_dim(sv2, li, 0, keepdims=False),
                         jnp.reshape(step + 1, (1,)),
                         jnp.reshape(li, (1,)),
+                        *((k_scales, v_scales) if quant else ()),
                     )
                     return out, (sk2, sv2)
 
@@ -187,8 +200,10 @@ def decode_burst(
             # new token attends itself)
             staged_valid = (staged_idx <= step)[None, :]  # [1, n_steps]
 
-            def make_attend(kp, vp, li, sk_all, sv_all):
-                pool_k, pool_v = gather_kv(kp, vp, block_tables)  # [B, mp*ps, n_kv, hd]
+            def make_attend(kp, vp, li, sk_all, sv_all, ks=None, vs=None):
+                pool_k, pool_v = gather_kv(
+                    kp, vp, block_tables, ks, vs, dtype=kv_dtype
+                )  # [B, mp*ps, n_kv, hd]
                 pool_valid = (
                     jnp.arange(pool_k.shape[1])[None, :] < start_lens[:, None]
                 )
@@ -211,16 +226,24 @@ def decode_burst(
         if use_pallas:
             # pools captured whole (rank-5 into the kernel), NOT sliced xs
             layer_xs = (params["layers"],)
+        elif quant:
+            layer_xs = (params["layers"], k_pages, v_pages, k_scales, v_scales)
         else:
             layer_xs = (params["layers"], k_pages, v_pages)
 
         def layer_body(lcarry, xs):
             h, sk_all, sv_all, li = lcarry
             # pallas: loop-invariant full pools; fallback: per-layer slices
-            p, kp, vp = xs if len(xs) == 3 else (xs[0], k_pages, v_pages)
-            h, (sk_all, sv_all) = _block(
-                cfg, h, p, cos, sin, make_attend(kp, vp, li, sk_all, sv_all)
-            )
+            if len(xs) == 1:
+                attend = make_attend(k_pages, v_pages, li, sk_all, sv_all)
+                p = xs[0]
+            elif len(xs) == 5:
+                p, kp, vp, ks, vs = xs
+                attend = make_attend(kp, vp, li, sk_all, sv_all, ks, vs)
+            else:
+                p, kp, vp = xs
+                attend = make_attend(kp, vp, li, sk_all, sv_all)
+            h, (sk_all, sv_all) = _block(cfg, h, p, cos, sin, attend)
             return (h, sk_all, sv_all, li + 1), None
 
         (h, staged_k, staged_v, _), _ = jax.lax.scan(
@@ -253,13 +276,23 @@ def decode_burst(
     slots = jnp.where(valid, slots, total_slots)  # sentinel -> mode="drop"
     flat_slots = slots.reshape(-1)  # [B*n_steps]
 
-    def commit(pools, staged):
+    def commit(pools, staged, scales=None):
         flat = pools.reshape(L, n_kv, total_slots, hd)
         # [L, B, n_kv, n, hd] -> [L, n_kv, B*n, hd] matching flat_slots order
         vals = staged.swapaxes(1, 2).reshape(L, n_kv, b * n_steps, hd)
-        flat = flat.at[:, :, flat_slots].set(vals, mode="drop")
-        return flat.reshape(pools.shape)
+        if scales is None:
+            flat = flat.at[:, :, flat_slots].set(vals, mode="drop")
+            return flat.reshape(pools.shape), None
+        from githubrepostorag_tpu.serving.kv_cache import quantize_kv
 
-    k_pages = commit(k_pages, staged_k)
-    v_pages = commit(v_pages, staged_v)
+        q, s = quantize_kv(vals)
+        flat = flat.at[:, :, flat_slots].set(q, mode="drop")
+        s_flat = scales.reshape(L, n_kv, total_slots)
+        s_flat = s_flat.at[:, :, flat_slots].set(s, mode="drop")
+        return flat.reshape(pools.shape), s_flat.reshape(scales.shape)
+
+    k_pages, k_scales = commit(k_pages, staged_k, k_scales)
+    v_pages, v_scales = commit(v_pages, staged_v, v_scales)
+    if quant:
+        return packed, valid, k_pages, v_pages, presence, out_lens, k_scales, v_scales
     return packed, valid, k_pages, v_pages, presence, out_lens

@@ -101,6 +101,8 @@ class Engine:
         max_seq_len: int = 2048,
         prefill_chunk: int = 512,
         kv_dtype=jnp.bfloat16,
+        kv_quant: bool = False,  # int8 KV pages with per-token scales —
+        # halves cache reads and doubles page capacity (kv_cache.quantize_kv)
         use_pallas: bool = False,
         rng_seed: int = 0,
         decode_burst: int = 8,
@@ -141,8 +143,17 @@ class Engine:
         # 1 reproduces plain per-token stepping
         self.decode_burst = max(1, decode_burst)
 
-        pools = make_page_pools(cfg, num_pages, page_size, dtype=kv_dtype)
+        self.kv_quant = kv_quant
+        if kv_quant and sp_prefill_threshold:
+            raise NotImplementedError(
+                "kv_quant + sp ring prefill: the ring commit writes "
+                "full-precision pages (serving/long_prefill.py); quantize "
+                "there before combining the two"
+            )
+        pools = make_page_pools(cfg, num_pages, page_size, dtype=kv_dtype,
+                                quant=kv_quant)
         self._k_pages, self._v_pages = pools.k, pools.v
+        self._k_scales, self._v_scales = pools.ks, pools.vs
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
 
@@ -150,6 +161,10 @@ class Engine:
             kv_sharding = NamedSharding(mesh, PS(None, kv_tp, None, None, None))
             self._k_pages = jax.device_put(self._k_pages, kv_sharding)
             self._v_pages = jax.device_put(self._v_pages, kv_sharding)
+            if kv_quant:
+                s_sharding = NamedSharding(mesh, PS(None, kv_tp, None, None))
+                self._k_scales = jax.device_put(self._k_scales, s_sharding)
+                self._v_scales = jax.device_put(self._v_scales, s_sharding)
             self._replicated = NamedSharding(mesh, PS())
         self.prefix_caching = prefix_caching
         self._allocator = (
@@ -473,14 +488,20 @@ class Engine:
         for i, v in enumerate(valids):
             last_idx[i] = v - 1
         with annotate("engine.prefill_batch"):
-            logits, self._k_pages, self._v_pages = forward_paged(
+            out = forward_paged(
                 self.params, self.cfg,
                 jnp.asarray(ids), jnp.asarray(pos),
                 self._k_pages, self._v_pages,
                 jnp.asarray(slots), jnp.asarray(bt),
                 jnp.asarray(cached), jnp.asarray(new_lens),
                 use_pallas=self.use_pallas, logits_at=jnp.asarray(last_idx),
+                k_scales=self._k_scales, v_scales=self._v_scales,
             )
+            if self.kv_quant:
+                (logits, self._k_pages, self._v_pages,
+                 self._k_scales, self._v_scales) = out
+            else:
+                logits, self._k_pages, self._v_pages = out
 
         # mark prompt tokens in the presence mask (repetition penalty input);
         # one batched scatter for the whole padded wave (padding rows have
@@ -660,7 +681,7 @@ class Engine:
         self._rng, key = jax.random.split(self._rng)
 
         with annotate("engine.decode_burst"):
-            toks, valid, self._k_pages, self._v_pages, self._presence, out_lens = decode_burst(
+            out = decode_burst(
                 self.params, self.cfg,
                 last_d, lens_d,
                 self._k_pages, self._v_pages, self._presence,
@@ -668,7 +689,14 @@ class Engine:
                 jnp.asarray(self._block_tables), key,
                 self._temp_d, self._top_p_d, self._top_k_d, self._rep_pen_d,
                 n_steps=n_steps, use_pallas=self.use_pallas, mesh=self.mesh,
+                k_scales=self._k_scales, v_scales=self._v_scales,
             )
+            if self.kv_quant:
+                (toks, valid, self._k_pages, self._v_pages, self._presence,
+                 out_lens, self._k_scales, self._v_scales) = out
+            else:
+                (toks, valid, self._k_pages, self._v_pages, self._presence,
+                 out_lens) = out
         prev = self._chain
         self._chain = {
             "last": toks[:, -1], "lens": out_lens, "pending": toks,
@@ -732,14 +760,20 @@ class Engine:
         with annotate("engine.spec_decode"):
             # full-width logits: [rb, k+1, V] — k is small, and verification
             # needs every position
-            logits, self._k_pages, self._v_pages = forward_paged(
+            out = forward_paged(
                 self.params, self.cfg,
                 jnp.asarray(ids), jnp.asarray(pos),
                 self._k_pages, self._v_pages,
                 jnp.asarray(slots), jnp.asarray(bt),
                 jnp.asarray(cached), jnp.asarray(new_lens),
                 use_pallas=self.use_pallas,
+                k_scales=self._k_scales, v_scales=self._v_scales,
             )
+            if self.kv_quant:
+                (logits, self._k_pages, self._v_pages,
+                 self._k_scales, self._v_scales) = out
+            else:
+                logits, self._k_pages, self._v_pages = out
 
         row_idx = np.zeros((rb,), dtype=np.int32)
         row_idx[: len(running)] = [r.row for r in running]

@@ -34,7 +34,7 @@ def ngram_propose(
     max_ngram: int = 4,
     min_ngram: int = 1,
 ) -> list[int]:
-    """Draft up to ``k`` tokens: find the most recent earlier occurrence of
+    """Draft up to ``k`` tokens: find the EARLIEST earlier occurrence of
     the longest suffix n-gram (length max_ngram down to min_ngram) and
     return the tokens that followed it.  Empty when nothing matches."""
     if k <= 0 or len(tokens) < min_ngram + 1:
@@ -43,10 +43,13 @@ def ngram_propose(
     n_tok = len(window)
     for n in range(min(max_ngram, n_tok - 1), min_ngram - 1, -1):
         suffix = window[-n:]
-        # most recent earlier occurrence wins (locality: recent repetitions
-        # predict better than distant ones); start <= n_tok - n - 1 means at
-        # least one token always follows the match
-        for start in range(n_tok - n - 1, -1, -1):
+        # EARLIEST occurrence wins (vLLM prompt-lookup order): on repetitive
+        # text the most recent match sits just before the suffix itself and
+        # truncates the draft to a token or two, while the earliest match
+        # has the longest continuation — measured 2.0 vs ~k tokens/dispatch
+        # on a pure repeat run; start <= n_tok - n - 1 means at least one
+        # token always follows the match
+        for start in range(0, n_tok - n):
             if window[start : start + n] == suffix:
                 return window[start + n : start + n + k]
     return []

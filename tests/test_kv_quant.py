@@ -1,0 +1,163 @@
+"""Int8 KV cache (kv_quant pools): per-token symmetric quantization,
+engine output parity against full-precision KV, prefix-cache composition,
+and the staged Pallas kernel's in-VMEM dequant (interpret mode).
+
+VERDICT r02 #5: int8 KV halves cache reads at long context and doubles
+effective page capacity under the 64-stream config (the KV-fit reasoning
+behind the reference's --max-model-len 11712, values.yaml:74).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+from githubrepostorag_tpu.serving import Engine, SamplingParams
+from githubrepostorag_tpu.serving.kv_cache import make_page_pools, quantize_kv
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    return cfg, params
+
+
+def _engine(params, cfg, **kw):
+    defaults = dict(max_num_seqs=2, num_pages=32, page_size=4, max_seq_len=64,
+                    kv_dtype=jnp.float32, decode_burst=8)
+    defaults.update(kw)
+    return Engine(params, cfg, **defaults)
+
+
+def test_quantize_kv_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 2.0, (3, 17, 64)), dtype=jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (3, 17)
+    back = q.astype(jnp.float32) * s[..., None]
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    # per-token symmetric: error <= scale/2 = amax/254 per vector
+    bound = np.abs(np.asarray(x)).max(axis=-1, keepdims=True) / 254 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_quantize_kv_zero_vector_safe():
+    q, s = quantize_kv(jnp.zeros((2, 8)))
+    assert np.asarray(q).max() == 0 and (np.asarray(s) > 0).all()
+
+
+def test_quant_pools_shapes_and_bytes():
+    cfg = Qwen2Config.tiny()
+    full = make_page_pools(cfg, 16, 8)
+    quant = make_page_pools(cfg, 16, 8, quant=True)
+    assert quant.k.dtype == jnp.int8
+    assert quant.ks.shape == quant.k.shape[:-1] and quant.ks.dtype == jnp.float32
+    payload = quant.k.nbytes + quant.ks.nbytes
+    assert payload < 0.65 * full.k.nbytes  # int8 + 1/hd scales vs bf16
+
+
+def test_engine_kv_quant_tracks_full_precision(tiny):
+    """Greedy decode over int8 KV must track the full-precision engine:
+    same first tokens, and token-for-token equality over a short horizon
+    (tiny scale, per-token scales — the quantization error is far below
+    typical logit gaps)."""
+    cfg, params = tiny
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    sp = SamplingParams(max_tokens=12, temperature=0.0, stop_token_ids=())
+    ref = [r.output_tokens for r in _engine(params, cfg).generate(prompts, sp)]
+    got = [r.output_tokens
+           for r in _engine(params, cfg, kv_quant=True).generate(prompts, sp)]
+    for r, g in zip(ref, got):
+        assert r[:6] == g[:6], (r, g)  # short horizon: identical
+        # full horizon: allow a late near-tie flip, not divergence
+        assert sum(a != b for a, b in zip(r, g)) <= 2, (r, g)
+
+
+def test_kv_quant_composes_with_prefix_cache(tiny):
+    """A warm request resuming from int8 cached pages must produce the
+    cold request's tokens — the page content is the quantized
+    representation either way."""
+    cfg, params = tiny
+    eng = _engine(params, cfg, kv_quant=True, prefix_caching=True)
+    prefix = list(range(1, 17))  # 4 full pages
+    sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+    cold = eng.generate([prefix + [20, 21]], sp)[0].output_tokens
+    warm = eng.generate([prefix + [20, 21]], sp)[0].output_tokens
+    assert eng._allocator.hit_tokens > 0
+    assert warm == cold
+
+
+def test_kv_quant_spec_decode_runs(tiny):
+    """Spec mode verifies drafts through forward_paged's quantized path."""
+    cfg, params = tiny
+    zero_layers = jax.tree.map(jnp.zeros_like, params["layers"])
+    rep_params = dict(params, layers=zero_layers)  # repeater: drafts accept
+    eng = _engine(rep_params, cfg, kv_quant=True, spec_ngram_k=4)
+    sp = SamplingParams(max_tokens=16, temperature=0.0, stop_token_ids=())
+    res = eng.generate([[5, 6, 7, 8]], sp)[0]
+    assert len(res.output_tokens) == 16
+    assert eng.spec_accepted > 0  # the repeating tail drafted + accepted
+
+
+def test_kv_quant_rejects_sp_ring_prefill(tiny):
+    cfg, params = tiny
+    from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
+
+    with pytest.raises(NotImplementedError, match="ring prefill"):
+        _engine(params, cfg, kv_quant=True, mesh=make_mesh(MeshPlan(sp=2)),
+                sp_prefill_threshold=32)
+
+
+def test_staged_kernel_int8_matches_dequant_reference(tiny):
+    """The Pallas staged kernel's in-VMEM dequant (interpret mode) must
+    match attention over the explicitly dequantized pool."""
+    from githubrepostorag_tpu.ops.attention import dense_attention
+    from githubrepostorag_tpu.ops.pallas_paged import paged_attention_decode_staged
+
+    rng = np.random.default_rng(1)
+    L, B, n_kv, group, hd, P, ps, n_steps = 3, 2, 2, 2, 16, 8, 4, 4
+    q = jnp.asarray(rng.normal(size=(B, 1, n_kv * group, hd)), dtype=jnp.float32)
+    kf = rng.normal(size=(L, n_kv, P, ps, hd)).astype(np.float32)
+    vf = rng.normal(size=(L, n_kv, P, ps, hd)).astype(np.float32)
+    kq, ks = quantize_kv(jnp.asarray(kf))
+    vq, vs = quantize_kv(jnp.asarray(vf))
+    bt = jnp.asarray(rng.permutation(P)[: B * 3].reshape(B, 3), dtype=jnp.int32)
+    pool_lens = jnp.asarray([9, 5], dtype=jnp.int32)
+    sk = jnp.asarray(rng.normal(size=(B, n_kv, n_steps, hd)), dtype=jnp.float32)
+    sv = jnp.asarray(rng.normal(size=(B, n_kv, n_steps, hd)), dtype=jnp.float32)
+    sl = jnp.asarray([2], dtype=jnp.int32)
+    li = jnp.asarray([1], dtype=jnp.int32)
+
+    got = paged_attention_decode_staged(
+        q, kq, vq, bt, pool_lens, sk, sv, sl, li, ks, vs, interpret=True
+    )
+
+    # reference: dequantize layer 1's pages, gather, dense attention
+    kd = np.asarray(kq, dtype=np.float32) * np.asarray(ks)[..., None]
+    vd = np.asarray(vq, dtype=np.float32) * np.asarray(vs)[..., None]
+    outs = []
+    for b in range(B):
+        pages = np.asarray(bt)[b]
+        k_seq = kd[1][:, pages].reshape(n_kv, -1, hd)  # [n_kv, 3*ps, hd]
+        v_seq = vd[1][:, pages].reshape(n_kv, -1, hd)
+        k_all = np.concatenate([k_seq, np.asarray(sk)[b]], axis=1)
+        v_all = np.concatenate([v_seq, np.asarray(sv)[b]], axis=1)
+        n_pool = int(pool_lens[b])
+        valid = np.zeros((k_all.shape[1],), dtype=bool)
+        valid[:n_pool] = True
+        valid[3 * ps : 3 * ps + int(sl[0])] = True
+        out = dense_attention(
+            q[b : b + 1],
+            jnp.asarray(k_all.transpose(1, 0, 2))[None],
+            jnp.asarray(v_all.transpose(1, 0, 2))[None],
+            causal=False,
+            kv_valid=jnp.asarray(valid)[None],
+        )
+        outs.append(np.asarray(out)[0])
+    ref = np.stack(outs)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-5)

@@ -55,15 +55,24 @@ def test_ngram_propose_finds_repeats():
     assert ngram_propose([1], 0) == []
 
 
-def test_ngram_propose_prefers_longest_then_most_recent():
-    # [8,2] occurs twice earlier; the MOST RECENT occurrence is at index 4
-    # (followed by 5), the older one at 0 (followed by 3)
+def test_ngram_propose_prefers_longest_then_earliest():
+    # [8,2] occurs twice earlier; the EARLIEST occurrence (index 0, vLLM
+    # prompt-lookup order) wins — its continuation is [3], not the more
+    # recent match's [5].  Earliest matters on repetitive text: the most
+    # recent match sits just before the suffix and truncates the draft.
     toks = [8, 2, 3, 0, 8, 2, 5, 0, 8, 2]
-    assert ngram_propose(toks, 1) == [5]
-    # a longer matching suffix wins over a shorter, more recent one
+    assert ngram_propose(toks, 1, max_ngram=2) == [3]
+    # a longer matching suffix wins over a shorter, earlier one
     toks2 = [1, 2, 3, 4, 7, 3, 4, 9, 1, 2, 3, 4]
     # suffix [1,2,3,4] matched at 0 -> continuation [7]
     assert ngram_propose(toks2, 1) == [7]
+
+
+def test_ngram_propose_repeat_run_drafts_full_k():
+    # a pure repeat run (the spec bench's regime): earliest-match ordering
+    # drafts k tokens; most-recent ordering would draft only 1
+    toks = [4, 1, 7] + [9] * 12
+    assert ngram_propose(toks, 6) == [9] * 6
 
 
 # ----------------------------------------------------------------- engine --
