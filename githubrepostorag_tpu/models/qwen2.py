@@ -24,6 +24,8 @@ from githubrepostorag_tpu.models.quant import (
     QuantizedEmbedding,
     QuantizedLinear,
     QuantizedLinear4,
+    _split_q4,
+    _with_layered_q4,
     dequant_weight,
     embedding_lookup,
     qmatmul,
@@ -321,7 +323,7 @@ def _embed_dtype(params: dict):
     return params["norm"].dtype
 
 
-def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
+def _logits(params: dict, h: jnp.ndarray, int4_kernel: bool = True) -> jnp.ndarray:
     """Final projection -> float32 logits (tied embedding or separate
     lm_head).  Operands stay in their stored dtype (bf16 on the MXU) with
     float32 accumulation via preferred_element_type — an explicit astype
@@ -340,7 +342,15 @@ def _logits(params: dict, h: jnp.ndarray) -> jnp.ndarray:
         return jnp.einsum(
             "bsd,vd->bsv", h, embed, preferred_element_type=jnp.float32
         )
-    if isinstance(lm_head, (QuantizedLinear, QuantizedLinear4)):
+    if isinstance(lm_head, QuantizedLinear4):
+        # XLA materializes the int4 unpack (~1 GB bf16 head per step) —
+        # q4_dispatch routes to the Pallas in-VMEM-dequant GEMM on TPU
+        # (two-dot XLA formulation elsewhere / under TP sharding)
+        from githubrepostorag_tpu.models.quant import q4_dispatch
+
+        return q4_dispatch(h, lm_head.q, lm_head.s, lm_head.zs,
+                           out_dtype=jnp.float32, kernel=int4_kernel)
+    if isinstance(lm_head, QuantizedLinear):
         # dequantized per use; the convert+scale fuses into the dot
         wd = dequant_weight(lm_head, h.dtype)
         return jnp.einsum("bsd,dv->bsv", h, wd, preferred_element_type=jnp.float32)
@@ -355,7 +365,10 @@ def make_dense_cache(cfg: Qwen2Config, batch: int, max_len: int, dtype=jnp.bfloa
     return jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "use_pallas"), donate_argnums=(4, 5))
+@partial(
+    jax.jit, static_argnames=("cfg", "use_pallas", "int4_kernel"),
+    donate_argnums=(4, 5),
+)
 def forward_paged(
     params: dict,
     cfg: Qwen2Config,
@@ -371,6 +384,8 @@ def forward_paged(
     logits_at: jnp.ndarray | None = None,  # [B] per-row position, see below
     k_scales: jnp.ndarray | None = None,  # [L, n_kv, P, page_size] f32 —
     v_scales: jnp.ndarray | None = None,  # int8 (kv_quant) pool scales
+    int4_kernel: bool = True,  # False under TP-sharded int4 weights
+    # (pallas_call has no GSPMD partitioning rule — see quant.Layered4XLA)
 ):
     """Prefill-chunk or decode step over the paged KV cache.
 
@@ -397,6 +412,7 @@ def forward_paged(
         params, cfg, input_ids, positions, k_pages, v_pages,
         slot_mapping, block_tables, cached_lens, new_lens, use_pallas,
         logits_at=logits_at, k_scales=k_scales, v_scales=v_scales,
+        int4_kernel=int4_kernel,
     )
 
 
@@ -415,6 +431,7 @@ def forward_paged_impl(
     logits_at: jnp.ndarray | None = None,
     k_scales: jnp.ndarray | None = None,
     v_scales: jnp.ndarray | None = None,
+    int4_kernel: bool = True,
 ):
     """Unjitted body of ``forward_paged`` so larger fused programs (the
     multi-step decode burst in serving/decode_burst.py) can inline it inside
@@ -425,6 +442,11 @@ def forward_paged_impl(
     if use_pallas and not quant:
         from githubrepostorag_tpu.ops.pallas_paged import paged_attention as attn_fn
     else:
+        # kv_quant: the ref/gather path with dequant.  Not a hot-path
+        # regression: forward_paged serves prefill chunks and spec
+        # verification, both S > 1 — shapes the pallas dispatcher routes
+        # to the gather path anyway; decode (S == 1) always runs in
+        # decode_burst, whose staged kernel reads int8 pages natively.
         attn_fn = paged_attention_ref
 
     b, s = input_ids.shape
@@ -440,12 +462,16 @@ def forward_paged_impl(
     flat_slots = slot_mapping.reshape(-1)  # [B*S]
     flat_slots = jnp.where(flat_slots < 0, total_slots, flat_slots)
 
-    def body(h, layer_xs):
+    scan_layers, q4_stacks = _split_q4(params["layers"])
+
+    def body(carry, layer_xs):
+        h, li = carry
         if quant:
             p, kp, vp, ks, vs = layer_xs
         else:
             p, kp, vp = layer_xs
             ks = vs = None
+        p = _with_layered_q4(p, q4_stacks, li, kernel=int4_kernel)
 
         def attend(q, k, v):
             # [n_kv, P*ps, hd] flat view; one slot vector shared by all heads
@@ -479,19 +505,22 @@ def forward_paged_impl(
             attn = attn_fn(q, new_kp, new_vp, block_tables, cached_lens, new_lens)
             return attn, (new_kp, new_vp)
 
-        return _block(cfg, h, p, cos, sin, attend)
+        h, cache = _block(cfg, h, p, cos, sin, attend)
+        return (h, li + 1), cache
 
     if quant:
-        xs = (params["layers"], k_pages, v_pages, k_scales, v_scales)
-        h, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(body, h, xs)
+        xs = (scan_layers, k_pages, v_pages, k_scales, v_scales)
+        (h, _), (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+            body, (h, 0), xs
+        )
     else:
-        h, (k_pages, v_pages) = jax.lax.scan(
-            body, h, (params["layers"], k_pages, v_pages)
+        (h, _), (k_pages, v_pages) = jax.lax.scan(
+            body, (h, 0), (scan_layers, k_pages, v_pages)
         )
     h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
     if logits_at is not None:
         h = jnp.take_along_axis(h, logits_at[:, None, None], axis=1)  # [B, 1, d]
-    logits = _logits(params, h)
+    logits = _logits(params, h, int4_kernel=int4_kernel)
     if quant:
         return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages

@@ -1,0 +1,226 @@
+"""Pallas TPU int4 weight-only GEMM: in-VMEM dequant fused into the dot.
+
+Why a kernel: XLA does not fuse the int4 unpack chain (nibble shift ->
+group reshape -> scale multiply -> concat) into a dot operand the way it
+fuses int8's convert+scale — device traces of the 7B int4 decode burst
+show it materializing reshaped/scaled copies at ~37 ms/step of reshapes
+plus ~26 ms/step of copies, making int4 3-6x SLOWER than int8.  Here the
+packed tile is DMA'd to VMEM (half the int8 bytes off HBM — the entire
+point of int4), unpacked and dequantized in VMEM, and fed straight to the
+MXU.
+
+Weights are the in-group plane-packed ``QuantizedLinear4`` layout
+(models/quant.py): byte row j of group g holds original rows (g*gsz + j)
+in the low nibble and (g*gsz + j + gsz/2) in the high nibble, so an
+input-tile that is a whole number of groups unpacks with one in-VMEM
+concat and its scale rows align exactly.
+
+Stacked [L, in/2, out] weights ride in WHOLE with the layer index as a
+prefetched scalar (same discipline as the rank-5 KV pools in
+pallas_paged.py): the burst's layer loop never dynamic-slices a weight
+into a materialized copy.
+
+Oracle: models/quant.py::q4_matmul (the two-dot XLA formulation) — exact
+same math, used on CPU and in interpret-mode tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_tile(total: int, unit: int, target: int) -> int:
+    """Largest multiple of ``unit`` that divides ``total``, is <= target,
+    AND keeps the TPU lane constraint (multiple of 128, unless it is the
+    whole dimension — Pallas requires block minor dims be 128-aligned or
+    full).  Falls back to ``total``."""
+    import math
+
+    step = math.lcm(unit, 128)
+    t = (target // step) * step
+    while t >= step:
+        if total % t == 0:
+            return t
+        t -= step
+    return total
+
+
+def _int4_kernel(*refs, half: int, n_gt: int, layered: bool, sliced: bool):
+    ii = pl.program_id(2)
+    n_ii = pl.num_programs(2)
+    # the scale blocks carry the FULL group axis (their shape must be
+    # 8/128-aligned or full); this in-tile's rows slice out at the REF.
+    # ``sliced`` is static: with one in-tile the whole block is the tile
+    # (and Mosaic needs no provably-8-aligned dynamic sublane offset —
+    # the wrapper guarantees n_gt % 8 == 0 whenever sliced)
+    if layered:
+        (_li_ref, xa_ref, xb_ref, q_ref, s_ref, zs_ref, out_ref, acc_ref) = refs
+        pq = q_ref[0]  # [IT/2, OT]
+        s = s_ref[0, pl.ds(ii * n_gt, n_gt)] if sliced else s_ref[0]
+        zs = zs_ref[0, pl.ds(ii * n_gt, n_gt)] if sliced else zs_ref[0]
+    else:
+        (xa_ref, xb_ref, q_ref, s_ref, zs_ref, out_ref, acc_ref) = refs
+        pq = q_ref[...]
+        s = s_ref[pl.ds(ii * n_gt, n_gt)] if sliced else s_ref[...]
+        zs = zs_ref[pl.ds(ii * n_gt, n_gt)] if sliced else zs_ref[...]
+
+    @pl.when(ii == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ot = pq.shape[-1]
+    dt = xa_ref.dtype  # bf16 serving; f32 in CPU-geometry tests
+    # The unpack is VPU-bound (every weight element pays mask+cast+scale
+    # while the MXU waits), so shave VPU work: no shift — the high nibble
+    # stays in place (pq & 0xF0 = 16*nib) with 1/16 folded into its scale
+    # — and no concat — the two nibble planes go to the MXU as TWO dots
+    # against the matching halves of x (in-group plane packing makes both
+    # planes contiguous row ranges).  Widening runs through int32: Mosaic
+    # legalizes neither uint8 shifts nor uint8->bf16 casts.
+    sdt = s.astype(dt)[:, None, :]
+    zdt = zs.astype(dt)[:, None, :]
+    # Unpack via int32 widening (Mosaic legalizes neither uint8 shifts nor
+    # uint8->bf16 casts; an int8-domain bitcast variant measured ~12%
+    # SLOWER — the convert path widens internally regardless).  No shift:
+    # the high nibble stays in place (pq & 0xF0 = 16*nib) with 1/16 folded
+    # into its scale.  Each plane's rows are distinct original rows, every
+    # one dequantizing as nib*s - zs — both planes subtract the FULL zs.
+    # The remaining cost is fundamental per-element convert throughput on
+    # the VPU (the kernel is compute-bound, not HBM-bound, at 7B: ~2.9 GB
+    # of int4 reads vs ~19 ms/step measured); the next step beyond this is
+    # W4A8 — int8 activations on the MXU's native int8 path with per-group
+    # int32 partial sums — which changes the accuracy contract.
+    pq32 = pq.astype(jnp.int32)
+    lo = (pq32 & 0x0F).astype(dt).reshape(n_gt, half, ot) * sdt - zdt
+    hi = (pq32 & 0xF0).astype(dt).reshape(n_gt, half, ot) * (sdt / 16) - zdt
+    # x arrives PRE-SPLIT into the two plane halves (wrapper-side — the
+    # [MT, n_gt, gsz] lane slicing is an unsupported shape cast in Mosaic,
+    # and activations are tiny for XLA to split)
+    x_a = xa_ref[...]  # [MT, IT/2]
+    x_b = xb_ref[...]
+    dn = (((1,), (0,)), ((), ()))
+    acc_ref[...] += jax.lax.dot_general(
+        x_a, lo.reshape(n_gt * half, ot), dn, preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        x_b, hi.reshape(n_gt * half, ot), dn, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ii == n_ii - 1)
+    def _():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def int4_matmul(
+    x: jnp.ndarray,  # [..., IN]
+    q: jnp.ndarray,  # [IN/2, OUT] or [L, IN/2, OUT] uint8 (in-group packed)
+    s: jnp.ndarray,  # [(L,) n_g, OUT] bf16 group scales
+    zs: jnp.ndarray,  # [(L,) n_g, OUT] bf16 (zero * scale)
+    layer: jnp.ndarray | None = None,  # scalar int32, REQUIRED when stacked
+    out_dtype=None,  # default x.dtype; jnp.float32 for logits
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``x @ dequant(q, s, zs)`` with the dequant in VMEM.  Returns
+    [..., OUT] in ``out_dtype``."""
+    layered = q.ndim == 3
+    if layered:
+        assert layer is not None, "stacked int4 weights need the layer index"
+    lead = x.shape[:-1]
+    in_dim = x.shape[-1]
+    out = q.shape[-1]
+    n_g = s.shape[-2]
+    gsz = in_dim // n_g
+    half = gsz // 2
+    out_dtype = out_dtype or x.dtype
+
+    m = 1
+    for d in lead:
+        m *= d
+    # pre-split x into the two in-group nibble plane halves, group-major
+    # ([m, n_g*half] each): tile ii's columns are then exactly groups
+    # [ii*n_gt, (ii+1)*n_gt)'s half-rows for both planes (the in-kernel
+    # lane slicing this replaces is an unsupported Mosaic shape cast)
+    xg = x.reshape(m, n_g, gsz)
+    xa = xg[:, :, :half].reshape(m, n_g * half)
+    xb = xg[:, :, half:].reshape(m, n_g * half)
+
+    # row tiling: whole batch in one tile up to 256 rows (decode), 256-row
+    # tiles beyond (prefill); padded rows compute garbage that is sliced off
+    if m <= 256:
+        m_padded = -(-m // 8) * 8
+        mt = m_padded
+    else:
+        m_padded = -(-m // 256) * 256
+        mt = 256
+    if m_padded != m:
+        xa = jnp.pad(xa, ((0, m_padded - m), (0, 0)))
+        xb = jnp.pad(xb, ((0, m_padded - m), (0, 0)))
+
+    # in-tile: a multiple of 8 GROUPS (so the scale slice offset is a
+    # provable sublane multiple), falling back to the whole input dim
+    # (single in-tile, no slicing)
+    it = _pick_tile(in_dim, gsz * 8, 1024)
+    # VMEM budget: dequantized w tile (bf16) + packed tile + acc
+    ot = _pick_tile(out, 1, max(512, (3 * 2**20) // (2 * it)))
+    n_gt = it // gsz
+
+    grid = (m_padded // mt, out // ot, in_dim // it)
+
+    def x_map(mi, oi, ii, *refs):
+        return (mi, ii)
+
+    def out_map(mi, oi, ii, *refs):
+        return (mi, oi)
+
+    if layered:
+        def q_map(mi, oi, ii, li):
+            return (li[0], ii, oi)
+
+        def s_map(mi, oi, ii, li):
+            return (li[0], 0, oi)
+
+        q_block = (1, it // 2, ot)
+        s_block = (1, n_g, ot)
+        scalars = [jnp.reshape(layer, (1,)).astype(jnp.int32)]
+    else:
+        def q_map(mi, oi, ii, *refs):
+            return (ii, oi)
+
+        def s_map(mi, oi, ii, *refs):
+            return (0, oi)
+
+        q_block = (it // 2, ot)
+        s_block = (n_g, ot)
+        scalars = []
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(scalars),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((mt, it // 2), x_map),
+            pl.BlockSpec((mt, it // 2), x_map),
+            pl.BlockSpec(q_block, q_map),
+            pl.BlockSpec(s_block, s_map),
+            pl.BlockSpec(s_block, s_map),
+        ],
+        out_specs=pl.BlockSpec((mt, ot), out_map),
+        scratch_shapes=[pltpu.VMEM((mt, ot), jnp.float32)],
+    )
+    kernel = functools.partial(
+        _int4_kernel, half=half, n_gt=n_gt, layered=layered,
+        sliced=in_dim // it > 1,
+    )
+    y = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_padded, out), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*scalars, xa, xb, q, s, zs)
+    return y[:m].reshape(*lead, out)

@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from githubrepostorag_tpu.models.qwen2 import Qwen2Config, _block, _embed_dtype, _logits
-from githubrepostorag_tpu.models.quant import embedding_lookup
+from githubrepostorag_tpu.models.quant import _split_q4, _with_layered_q4, embedding_lookup
 from githubrepostorag_tpu.ops.attention import dense_attention
 from githubrepostorag_tpu.ops.paged_attention import gather_kv
 from githubrepostorag_tpu.ops.pallas_paged import paged_attention_decode_staged
@@ -136,8 +136,10 @@ def decode_burst(
     quant = k_scales is not None
     # staged tail stays full precision even over int8 pools — it is tiny
     # (MBs) and fresh tokens re-read every step; only the committed pages
-    # carry the int8 + per-token-scale representation
-    kv_dtype = jnp.bfloat16 if quant else k_pages.dtype
+    # carry the int8 + per-token-scale representation.  Full precision
+    # means the ACTIVATION dtype (an f32 engine must not silently truncate
+    # its staged K/V to bf16)
+    kv_dtype = _embed_dtype(params) if quant else k_pages.dtype
 
     staged_shape = (L, b, n_kv, n_steps, hd)
     staged_k0 = jnp.zeros(staged_shape, dtype=kv_dtype)
@@ -223,13 +225,21 @@ def decode_burst(
 
                 return attend
 
+        # int4 projection stacks stay OUT of the scan xs: a Layered4 view
+        # (full arrays + layer index) feeds the Pallas int4 GEMM directly,
+        # so no per-layer weight slice materializes (models/quant.py).
+        # Under TP the weights are GSPMD-sharded and the kernel (an opaque
+        # custom call) would force an all-gather — the XLA-route view
+        # partitions instead (quant.Layered4XLA)
+        int4_kernel = mesh is None or mesh.shape.get("tp", 1) == 1
+        scan_layers, q4_stacks = _split_q4(params["layers"])
         if use_pallas:
             # pools captured whole (rank-5 into the kernel), NOT sliced xs
-            layer_xs = (params["layers"],)
+            layer_xs = (scan_layers,)
         elif quant:
-            layer_xs = (params["layers"], k_pages, v_pages, k_scales, v_scales)
+            layer_xs = (scan_layers, k_pages, v_pages, k_scales, v_scales)
         else:
-            layer_xs = (params["layers"], k_pages, v_pages)
+            layer_xs = (scan_layers, k_pages, v_pages)
 
         def layer_body(lcarry, xs):
             h, sk_all, sv_all, li = lcarry
@@ -243,13 +253,14 @@ def decode_burst(
             else:
                 p, kp, vp = xs
                 attend = make_attend(kp, vp, li, sk_all, sv_all)
+            p = _with_layered_q4(p, q4_stacks, li, kernel=int4_kernel)
             h, (sk_all, sv_all) = _block(cfg, h, p, cos, sin, attend)
             return (h, sk_all, sv_all, li + 1), None
 
         (h, staged_k, staged_v, _), _ = jax.lax.scan(
             layer_body, (h, staged_k, staged_v, 0), layer_xs,
         )
-        logits = _logits(params, h)
+        logits = _logits(params, h, int4_kernel=int4_kernel)
 
         toks = sample_tokens_capped(
             logits[:, 0], step_rng, temperature, top_p, top_k,

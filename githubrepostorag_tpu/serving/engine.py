@@ -144,6 +144,10 @@ class Engine:
         self.decode_burst = max(1, decode_burst)
 
         self.kv_quant = kv_quant
+        # int4 weights route to the Pallas GEMM only when unsharded (an
+        # opaque pallas_call has no GSPMD partitioning rule); TP meshes
+        # take the partitionable XLA formulation (quant.Layered4XLA)
+        self._int4_kernel = mesh is None or mesh.shape.get("tp", 1) == 1
         if kv_quant and sp_prefill_threshold:
             raise NotImplementedError(
                 "kv_quant + sp ring prefill: the ring commit writes "
@@ -496,6 +500,7 @@ class Engine:
                 jnp.asarray(cached), jnp.asarray(new_lens),
                 use_pallas=self.use_pallas, logits_at=jnp.asarray(last_idx),
                 k_scales=self._k_scales, v_scales=self._v_scales,
+                int4_kernel=self._int4_kernel,
             )
             if self.kv_quant:
                 (logits, self._k_pages, self._v_pages,
@@ -768,6 +773,7 @@ class Engine:
                 jnp.asarray(cached), jnp.asarray(new_lens),
                 use_pallas=self.use_pallas,
                 k_scales=self._k_scales, v_scales=self._v_scales,
+                int4_kernel=self._int4_kernel,
             )
             if self.kv_quant:
                 (logits, self._k_pages, self._v_pages,

@@ -4,8 +4,9 @@ forward parity, engine integration, TP composition, AWQ repacking.
 The reference's deployed model is 4-bit AWQ (vLLM serving
 Qwen2.5-Coder-7B-Instruct-AWQ — /root/reference/helm/values.yaml:67);
 models/quant.py::QuantizedLinear4 is the TPU-native equivalent: group-wise
-asymmetric uint4, plane-packed two nibbles per byte, dequant fused into the
-consuming dot by XLA.
+asymmetric uint4, plane-packed two nibbles per byte, dequantized in VMEM by
+the Pallas GEMM (ops/pallas_int4.py) on TPU and by the two-dot XLA
+formulation (q4_matmul) elsewhere.
 """
 
 import numpy as np
@@ -204,3 +205,44 @@ def test_int4_halves_weight_bytes_vs_int8():
     cfg = Qwen2Config.tiny()
     assert params_nbytes(init_params_quantized(cfg, bits=4, group_size=G)) < \
         params_nbytes(init_params_quantized(cfg, bits=8))
+
+
+def test_pallas_int4_matmul_matches_oracle():
+    """The Pallas in-VMEM-dequant GEMM (interpret mode) must match the
+    two-dot XLA oracle (q4_matmul) for both unstacked and stacked+layered
+    weights, including padded row counts."""
+    from githubrepostorag_tpu.models.quant import q4_matmul
+    from githubrepostorag_tpu.ops.pallas_int4 import int4_matmul
+
+    rng = np.random.default_rng(9)
+    IN, OUT, L = 64, 48, 3
+    w = jnp.asarray(rng.normal(0, 0.02, (L, IN, OUT)), dtype=jnp.float32)
+    qt = quantize_weight4(w, group_size=G)
+    for m in (1, 5, 8):
+        x = jnp.asarray(rng.normal(size=(m, IN)), dtype=jnp.float32)
+        for li in (0, 2):
+            sl = lambda a: a[li]
+            ref = q4_matmul(x, QuantizedLinear4(sl(qt.q), sl(qt.s), sl(qt.zs)))
+            got_l = int4_matmul(x, qt.q, qt.s, qt.zs,
+                                layer=jnp.asarray(li, dtype=jnp.int32),
+                                interpret=True)
+            got_u = int4_matmul(x, sl(qt.q), sl(qt.s), sl(qt.zs), interpret=True)
+            np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref),
+                                       rtol=2e-2, atol=1e-4)
+            np.testing.assert_allclose(np.asarray(got_u), np.asarray(ref),
+                                       rtol=2e-2, atol=1e-4)
+
+
+def test_pallas_int4_matmul_3d_batch_and_f32_out():
+    from githubrepostorag_tpu.models.quant import q4_matmul
+    from githubrepostorag_tpu.ops.pallas_int4 import int4_matmul
+
+    rng = np.random.default_rng(10)
+    IN, OUT = 32, 64
+    w = jnp.asarray(rng.normal(0, 0.02, (IN, OUT)), dtype=jnp.float32)
+    qt = quantize_weight4(w, group_size=G)
+    x = jnp.asarray(rng.normal(size=(2, 3, IN)), dtype=jnp.float32)
+    ref = q4_matmul(x, qt, preferred=jnp.float32)
+    got = int4_matmul(x, qt.q, qt.s, qt.zs, out_dtype=jnp.float32, interpret=True)
+    assert got.shape == (2, 3, OUT) and got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-2, atol=1e-4)
