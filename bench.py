@@ -474,7 +474,7 @@ def _main() -> None:
             tps7i4 = bench_7b(bits=4)
             emit("decode_tok_s_per_chip_qwen2-7b_int4_bs32", tps7i4, "tok/s",
                  tps7i4 / BASELINE_TOK_S)
-        if budget_allows("qwen2-7b-int8", 540):
+        if budget_allows("qwen2-7b-int8", 900):
             tps7 = bench_7b(bits=8)
             emit("decode_tok_s_per_chip_qwen2-7b_int8_bs32", tps7, "tok/s",
                  tps7 / BASELINE_TOK_S)
