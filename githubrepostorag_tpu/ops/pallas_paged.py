@@ -179,8 +179,10 @@ def _decode_staged_kernel(
     (1, n_kv, n_steps, hd), + k/v scale tiles when ``kv_quant``], out
     (1, n_kv, group, hd), scratch [m, l (n_kv, group, 128) f32, acc
     (n_kv, group, hd) f32].  ``kv_quant``: pool tiles are int8 with
-    per-token scales ([.., page_size] tiles riding the same page index
-    map); dequant happens here in VMEM, right before the dots."""
+    per-token scales arriving as [.., page_size, 1] blocks — the trailing
+    singleton keeps the block minor dims Mosaic-tileable — riding the
+    same page index map; dequant happens here in VMEM, right before the
+    dots."""
     n_scalars = 4 if layered else 3
     n_blocks = 7 if kv_quant else 5
     scalar_refs = refs[:n_scalars]
@@ -191,13 +193,19 @@ def _decode_staged_kernel(
     if layered:
         raw_k = lambda: k_ref[0, :, 0]  # [n_kv, page_size, hd]
         raw_v = lambda: v_ref[0, :, 0]
-        page_scale = lambda ref: ref[0, :, 0]  # [n_kv, page_size]
     else:
         raw_k = lambda: k_ref[:, 0]
         raw_v = lambda: v_ref[:, 0]
-        page_scale = lambda ref: ref[:, 0]
     if kv_quant:
+        # scale operands carry a trailing singleton so their BLOCK minor
+        # dims are (page_size, 1) — a (.., 1, page_size) block would put
+        # the one-page axis second-minor, which Mosaic rejects (not
+        # 8-aligned, not the full page axis)
         ks_ref, vs_ref = blocks[5:]
+        if layered:
+            page_scale = lambda ref: ref[0, :, 0, :, 0]  # [n_kv, page_size]
+        else:
+            page_scale = lambda ref: ref[:, 0, :, 0]
         k_page = lambda: (
             raw_k().astype(jnp.float32) * page_scale(ks_ref)[..., None]
         )
@@ -325,20 +333,14 @@ def paged_attention_decode_staged(
         def kv_map(bi, pi, bt, pool, sl, *rest):
             return (rest[0][0], 0, clamp_page(bi, pi, bt, pool), 0, 0)
 
-        def scale_map(bi, pi, bt, pool, sl, *rest):
-            return (rest[0][0], 0, clamp_page(bi, pi, bt, pool), 0)
-
         kv_block = (1, n_kv, 1, page_size, hd)
-        scale_block = (1, n_kv, 1, page_size)
+        scale_block = (1, n_kv, 1, page_size, 1)
     else:
         def kv_map(bi, pi, bt, pool, sl, *rest):
             return (0, clamp_page(bi, pi, bt, pool), 0, 0)
 
-        def scale_map(bi, pi, bt, pool, sl, *rest):
-            return (0, clamp_page(bi, pi, bt, pool), 0)
-
         kv_block = (n_kv, 1, page_size, hd)
-        scale_block = (n_kv, 1, page_size)
+        scale_block = (n_kv, 1, page_size, 1)
 
     def staged_map(bi, pi, *refs):
         return (bi, 0, 0, 0)
@@ -360,8 +362,10 @@ def paged_attention_decode_staged(
     ]
     operands = [q_r, k_pages, v_pages, staged_k, staged_v]
     if kv_quant:
-        in_specs += [pl.BlockSpec(scale_block, scale_map)] * 2
-        operands += [k_scales, v_scales]
+        # scale tiles ride kv_map: same (layer, page) block per grid step
+        in_specs += [pl.BlockSpec(scale_block, kv_map)] * 2
+        # trailing singleton keeps the block minor dims (page_size, 1)
+        operands += [k_scales[..., None], v_scales[..., None]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(scalars),
         grid=grid,
