@@ -1,0 +1,32 @@
+"""Shared persistent-XLA-compile-cache bootstrap for the repo entrypoints
+(bench.py, __graft_entry__.py — import and call before compiling).
+
+TPU ONLY, decided WITHOUT initializing a backend: through the remote-TPU
+tunnel, CPU compilation also happens server-side, so cached XLA:CPU AOT
+blobs target the SERVER's microarchitecture — loading them in a local
+virtual-mesh subprocess warns about mismatched machine features and can
+SIGILL.  The gate reads JAX_PLATFORMS (the virtual-mesh subprocess and
+CPU CI set it to "cpu") instead of jax.default_backend(), which would
+eagerly initialize the pinned platform at import and defeat the
+documented lazy jax.config.update("jax_platforms", ...) override.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+
+def enable_persistent_cache() -> bool:
+    """Point JAX's compilation cache at <repo>/.jax_cache unless this
+    process is pinned to CPU.  Returns whether the cache was enabled."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        return False
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        str(pathlib.Path(__file__).resolve().parent / ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return True
