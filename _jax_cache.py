@@ -19,10 +19,21 @@ import pathlib
 
 def enable_persistent_cache() -> bool:
     """Point JAX's compilation cache at <repo>/.jax_cache unless this
-    process is pinned to CPU.  Returns whether the cache was enabled."""
-    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
-        return False
+    process is pinned to CPU.  Returns whether the cache was enabled.
+
+    Two pinning mechanisms are honored: the JAX_PLATFORMS env var, and a
+    prior jax.config.update("jax_platforms", ...) — the documented
+    override for hosts whose sitecustomize pins the platform at
+    interpreter start (reading the config value does NOT initialize a
+    backend).  Only an unambiguous cpu-only pin disables the cache."""
     import jax
+
+    pins = [
+        os.environ.get("JAX_PLATFORMS", ""),
+        jax.config.jax_platforms or "",
+    ]
+    if any(p.strip().lower() == "cpu" for p in pins):
+        return False
 
     jax.config.update(
         "jax_compilation_cache_dir",
