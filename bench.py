@@ -294,12 +294,13 @@ def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
 
 def bench_7b(bits: int) -> float:
     """Qwen2-7B geometry with weight-only quantization on one chip, bs=32:
-    the model the BASELINE targets are stated for.  ``bits=4`` is the
-    AWQ-class scheme the reference deploys (values.yaml:67) — ~3.9 GB of
-    weights vs int8's ~7.7 GB; decode is weight-read bound, so int4 is the
-    headline.  Random quantized weights built host-side (a bf16 7B tree
-    cannot be materialized on-chip to quantize); everything else — warmup,
-    Pallas fallback, medians — reuses bench_decode."""
+    the model the BASELINE targets are stated for.  ``bits=8`` is the
+    single-chip throughput flagship (clears the 2000 tok/s floor);
+    ``bits=4`` is the AWQ-class scheme the reference deploys
+    (/root/reference/helm/values.yaml:67) — ~3.9 GB of weights vs int8's
+    ~7.7 GB through the Pallas dequant GEMM.  Random quantized weights
+    built host-side (a bf16 7B tree cannot be materialized on-chip to
+    quantize); warmup and Pallas fallback reuse bench_decode."""
     from githubrepostorag_tpu.models.quant import init_params_quantized, params_nbytes
     from githubrepostorag_tpu.models.qwen2 import Qwen2Config
 
@@ -311,11 +312,15 @@ def bench_7b(bits: int) -> float:
     jax.block_until_ready(params)
     log(f"bench[{tag}]: {params_nbytes(params) / 1e9:.2f} GB on chip; compiling")
     # burst 32 (not 64): the 7B burst program's XLA compile time scales
-    # with n_steps and already dominates a cold-cache run of this item
+    # with n_steps and already dominates a cold-cache run of this item.
+    # runs=1 and 96 tokens: the host->device weight transfer dominates the
+    # item's cost either way, and one run buys room for more items under
+    # the driver's budget (tunnel variance is ±10-15%; the multi-run
+    # medians are recorded in README/COVERAGE)
     tps, _, _ = bench_decode(cfg, tag, batch=32, prompt_len=128,
-                             gen_tokens=128, num_pages=160, page_size=256,
+                             gen_tokens=96, num_pages=160, page_size=256,
                              max_seq=1024, params=params, decode_burst=32,
-                             runs=2)
+                             runs=1)
     return tps
 
 
@@ -354,6 +359,21 @@ def _main() -> None:
                                     gen_tokens=256, num_pages=64, page_size=256,
                                     max_seq=1024, decode_burst=128)
     emit("decode_tok_s_per_chip_qwen2-0.5b_bs8", tps, "tok/s", tps / BASELINE_TOK_S)
+
+    # ---- eval config #3 geometry: Qwen2-7B int8 — THE flagship (the model
+    # the BASELINE targets are stated for), SECOND in the running order so
+    # a tight driver budget sheds cheap tail items, never this.  A 7B item
+    # needs ~10 GB, so params05 releases before whichever 7B item runs
+    # first ("release every earlier model's params first" — observed
+    # RESOURCE_EXHAUSTED otherwise) and re-inits lazily afterwards.
+    run_7b = os.environ.get("BENCH_7B", "1") != "0"
+    if run_7b and budget_allows("qwen2-7b-int8", 700):
+        params05 = None  # rebind frees the device tree
+        gc.collect()
+        tps7 = bench_7b(bits=8)
+        emit("decode_tok_s_per_chip_qwen2-7b_int8_bs32", tps7, "tok/s",
+             tps7 / BASELINE_TOK_S)
+        gc.collect()
 
     # ---- eval config #2 geometry (1.5B, bs=8 and bs=32) ------------------
     cfg15 = Qwen2Config.qwen2_1_5b()
@@ -411,9 +431,30 @@ def _main() -> None:
     del params15
     gc.collect()
 
+    # ---- Qwen2-7B int4 (the reference's AWQ scheme; Pallas dequant GEMM) --
+    if run_7b and budget_allows("qwen2-7b-int4", 300):
+        params05 = None  # rebind frees the device tree (if still resident)
+        gc.collect()
+        tps7i4 = bench_7b(bits=4)
+        emit("decode_tok_s_per_chip_qwen2-7b_int4_bs32", tps7i4, "tok/s",
+             tps7i4 / BASELINE_TOK_S)
+        gc.collect()
+
+    # lazy restore after a 7B item evicted the 0.5B tree — paid only once
+    # a tail item has actually cleared its budget gate
+    def params05_or_init():
+        nonlocal params05
+        if params05 is None:
+            log("bench: re-init 0.5B params for the remaining items")
+            from githubrepostorag_tpu.models.qwen2 import init_params
+
+            params05 = init_params(cfg05, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+            jax.block_until_ready(params05)
+        return params05
+
     # ---- eval configs #5 + #4 on 0.5B (continuity with r01/r02) ----------
     if budget_allows("concurrent64-0.5b", 180):
-        eng = Engine(params05, cfg05, max_num_seqs=64, num_pages=320, page_size=64,
+        eng = Engine(params05_or_init(), cfg05, max_num_seqs=64, num_pages=320, page_size=64,
                      max_seq_len=1024, prefill_chunk=256, use_pallas=True,
                      decode_burst=32)
         log("bench[64seq]: warmup (compiles all row buckets)")
@@ -437,7 +478,7 @@ def _main() -> None:
     # NEGATIVE for throughput: the per-element page dequant is VPU-bound,
     # so kv_quant is a capacity knob, not a speed knob, on this hardware)
     if budget_allows("concurrent64-kvq", 180):
-        engq = Engine(params05, cfg05, max_num_seqs=64, num_pages=320,
+        engq = Engine(params05_or_init(), cfg05, max_num_seqs=64, num_pages=320,
                       page_size=64, max_seq_len=1024, prefill_chunk=256,
                       use_pallas=True, decode_burst=32, kv_quant=True)
         log("bench[64seq-kvquant]: warmup (compiles all row buckets)")
@@ -453,7 +494,7 @@ def _main() -> None:
 
     # ---- speculative decoding in its acceptance regime -------------------
     if budget_allows("spec-decode", 150):
-        tpd, acc, spec_wall, burst_wall = bench_spec_decode(params05, cfg05)
+        tpd, acc, spec_wall, burst_wall = bench_spec_decode(params05_or_init(), cfg05)
         emit("spec_decode_tok_per_dispatch_qwen2-0.5b", tpd, "tok/dispatch", None)
         emit("spec_decode_acceptance_qwen2-0.5b", acc, "ratio", None)
         emit("spec_decode_speedup_vs_burst_bs1", burst_wall / max(spec_wall, 1e-9),
@@ -464,20 +505,6 @@ def _main() -> None:
         rate = bench_embedding(chunks=4096, seq_len=256, batch=256)
         emit("embed_chunks_s_e5-small", rate, "chunks/s", None)
 
-    # ---- eval config #3 geometry: Qwen2-7B int4 (headline) + int8 --------
-    # the 7B needs 4-10 GB: release every earlier model's params/engines
-    # first or device HBM still holds them (observed RESOURCE_EXHAUSTED)
-    del params05
-    gc.collect()
-    if os.environ.get("BENCH_7B", "1") != "0":
-        if budget_allows("qwen2-7b-int4", 420):
-            tps7i4 = bench_7b(bits=4)
-            emit("decode_tok_s_per_chip_qwen2-7b_int4_bs32", tps7i4, "tok/s",
-                 tps7i4 / BASELINE_TOK_S)
-        if budget_allows("qwen2-7b-int8", 900):
-            tps7 = bench_7b(bits=8)
-            emit("decode_tok_s_per_chip_qwen2-7b_int8_bs32", tps7, "tok/s",
-                 tps7 / BASELINE_TOK_S)
 
 
 if __name__ == "__main__":
