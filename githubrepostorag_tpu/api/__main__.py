@@ -36,7 +36,8 @@ def _build_llm():
         import ml_dtypes
 
         params, cfg = load_qwen2(
-            s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights
+            s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights,
+            moe_capacity_factor=s.moe_capacity_factor,
         )
         engine = Engine(
             params, cfg,
@@ -45,6 +46,7 @@ def _build_llm():
             page_size=s.kv_page_size,
             max_seq_len=s.context_window,
             prefill_chunk=s.prefill_chunk,
+            kv_quant=s.kv_quant,
             use_pallas=jax.default_backend() == "tpu",
         )
         return InProcessLLM(AsyncEngine(engine), make_tokenizer(s.model_weights_path))

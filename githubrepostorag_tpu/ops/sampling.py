@@ -107,12 +107,16 @@ def sample_tokens_capped(
         idx = idx.astype(jnp.int32)
     else:
         # approx_max_k's default aggregate_to_topk=True ENDS with an exact
-        # sorted top-cap over its oversampled candidate bins (the recall
+        # sorted top-k over its oversampled candidate bins (the recall
         # knob controls the internal oversampling), so its output is
         # already what a second lax.top_k would produce — device profiling
-        # showed that redundant second sort costing ~0.1 ms/decode step
-        vals, idx = jax.lax.approx_max_k(scaled, cap, recall_target=0.99)
-        idx = idx.astype(jnp.int32)
+        # showed that redundant second sort costing ~0.1 ms/decode step.
+        # Pull 2*cap candidates and slice the (exactly sorted) first cap:
+        # same candidate recall as the r02 approx(2*cap)+top_k(cap) scheme
+        # at a fraction of the old second sort's cost
+        pool = min(2 * cap, vocab)
+        vals, idx = jax.lax.approx_max_k(scaled, pool, recall_target=0.99)
+        vals, idx = vals[:, :cap], idx[:, :cap].astype(jnp.int32)
     # top-k within the cap: positions >= k masked (k<=0 disables)
     ranks = jnp.arange(cap)[None, :]
     k_arr = top_k[:, None]

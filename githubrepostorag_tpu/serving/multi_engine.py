@@ -121,9 +121,18 @@ class MultiAsyncEngine:
 
     def stats(self) -> dict[str, Any]:
         per = [eng.stats() for eng in self._engines]
-        merged: dict[str, Any] = {
-            key: sum(s[key] for s in per) for key in per[0]
-        }
+        # union of keys; sum only numeric values (a non-numeric or
+        # replica-local stat stays visible under per_replica)
+        keys = sorted(set().union(*(s.keys() for s in per)))
+        merged: dict[str, Any] = {}
+        for key in keys:
+            nums = [
+                s[key] for s in per
+                if isinstance(s.get(key), (int, float))
+                and not isinstance(s.get(key), bool)
+            ]
+            if nums:
+                merged[key] = sum(nums)
         merged["replicas"] = len(per)
         merged["per_replica"] = per
         return merged

@@ -254,20 +254,66 @@ def cql_literal(value) -> str:
         return "'" + value.replace("'", "''") + "'"
     if isinstance(value, bool):
         return "true" if value else "false"
+    # numpy scalars BEFORE (int, float): np.float64 subclasses float but
+    # its numpy-2.x repr ("np.float64(1.5)") is not a CQL literal
+    if isinstance(value, np.integer):
+        return repr(int(value))
+    if isinstance(value, np.floating):
+        return repr(float(value))
     if isinstance(value, (int, float)):
         return repr(value)
     if isinstance(value, Mapping):
         items = ", ".join(f"{cql_literal(k)}: {cql_literal(v)}" for k, v in value.items())
         return "{" + items + "}"
-    if isinstance(value, (list, tuple, np.ndarray)):
-        return "[" + ", ".join(repr(float(x)) for x in np.asarray(value).reshape(-1)) + "]"
+    if isinstance(value, np.ndarray):  # vector columns: always float elements
+        return "[" + ", ".join(repr(float(x)) for x in value.reshape(-1)) + "]"
+    if isinstance(value, (list, tuple)):
+        return "[" + ", ".join(cql_literal(x) for x in value) + "]"
     raise TypeError(f"no CQL literal form for {type(value)!r}")
 
 
 def interpolate(cql: str, params: Sequence | None) -> str:
-    if not params:
-        return cql
-    return cql % tuple(cql_literal(p) for p in params)
+    """Substitute ``%s`` placeholders with CQL literals by a quote-aware
+    token scan — NOT Python %-formatting.  ``%`` (and even ``%s``) inside
+    a ``'...'`` string literal passes through untouched (``''`` is the CQL
+    escaped quote and stays inside the literal), so statements like
+    ``LIKE '%sql%'`` never raise or splice params into the literal."""
+    params = () if params is None else params
+    out: list[str] = []
+    it = iter(params)
+    used = 0
+    i, n = 0, len(cql)
+    in_str = False
+    while i < n:
+        ch = cql[i]
+        if in_str:
+            if ch == "'":
+                if i + 1 < n and cql[i + 1] == "'":  # escaped quote ''
+                    out.append("''")
+                    i += 2
+                    continue
+                in_str = False
+            out.append(ch)
+            i += 1
+        elif ch == "'":
+            in_str = True
+            out.append(ch)
+            i += 1
+        elif ch == "%" and i + 1 < n and cql[i + 1] == "s":
+            try:
+                out.append(cql_literal(next(it)))
+            except StopIteration:
+                raise ValueError(
+                    f"statement has more %s placeholders than the {len(params)} params"
+                ) from None
+            used += 1
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    if used != len(params):
+        raise ValueError(f"statement has {used} %s placeholders, got {len(params)} params")
+    return "".join(out)
 
 
 # ---- rows ----------------------------------------------------------------
@@ -378,17 +424,30 @@ class CQLSession:
             raise CQLError(code, buf.string())
         return op, buf
 
-    def _request(self, opcode: int, body: bytes) -> tuple[int, _Buf]:
+    def _request(
+        self, opcode: int, body: bytes, idempotent: bool = True
+    ) -> tuple[int, _Buf]:
+        """One exchange with reconnect-and-replay on a dead socket.  Replay
+        after an ambiguous failure (the request may already have applied
+        server-side) is gated on ``idempotent`` — every statement this
+        store issues is row_id-keyed upsert/read/delete so callers default
+        to True; a future non-idempotent statement (counter update,
+        non-keyed insert) must pass ``idempotent=False`` through
+        ``execute`` and handle the reconnect error itself."""
         with self._lock:
             try:
                 return self._exchange_locked(opcode, body)
             except OSError:
-                # dead/misaligned socket: reconnect once and replay
+                # dead/misaligned socket: reconnect; replay only if safe
                 self._connect_locked()
+                if not idempotent:
+                    raise
                 return self._exchange_locked(opcode, body)
             except CQLError as exc:
                 if exc.code == 0 and "connection closed" in str(exc):
                     self._connect_locked()
+                    if not idempotent:
+                        raise
                     return self._exchange_locked(opcode, body)
                 raise
 
@@ -403,12 +462,14 @@ class CQLSession:
 
     # -- public API --
 
-    def execute(self, query, params: Sequence | None = None) -> ResultSet:
+    def execute(
+        self, query, params: Sequence | None = None, idempotent: bool = True
+    ) -> ResultSet:
         if isinstance(query, PreparedStatement):
-            return self._execute_prepared(query, params or ())
+            return self._execute_prepared(query, params or (), idempotent=idempotent)
         cql = interpolate(query, params)
         body = _long_string(cql) + struct.pack(">HB", CONSISTENCY_ONE, 0)
-        op, buf = self._request(OP_QUERY, body)
+        op, buf = self._request(OP_QUERY, body, idempotent=idempotent)
         return self._parse_result(op, buf)
 
     def prepare(self, cql: str) -> PreparedStatement:
@@ -442,7 +503,9 @@ class CQLSession:
 
     # -- internals --
 
-    def _execute_prepared(self, stmt: PreparedStatement, params: Sequence) -> ResultSet:
+    def _execute_prepared(
+        self, stmt: PreparedStatement, params: Sequence, idempotent: bool = True
+    ) -> ResultSet:
         if len(params) != len(stmt.bind_types):
             raise CQLError(
                 0, f"bound {len(params)} values to {len(stmt.bind_types)} markers"
@@ -456,7 +519,7 @@ class CQLSession:
             + struct.pack(">H", len(params)) + values
         )
         try:
-            op, buf = self._request(OP_EXECUTE, body)
+            op, buf = self._request(OP_EXECUTE, body, idempotent=idempotent)
         except CQLError as exc:
             # UNPREPARED: the (possibly restarted) node lost this statement
             # — re-prepare in place and retry ONCE (no recursion: a second
@@ -470,7 +533,7 @@ class CQLSession:
                 + struct.pack(">HB", CONSISTENCY_ONE, 0x01)
                 + struct.pack(">H", len(params)) + values
             )
-            op, buf = self._request(OP_EXECUTE, body)
+            op, buf = self._request(OP_EXECUTE, body, idempotent=idempotent)
         return self._parse_result(op, buf)
 
     def _parse_result(self, op: int, buf: _Buf) -> ResultSet:

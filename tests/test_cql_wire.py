@@ -150,3 +150,33 @@ def test_unicode_text_roundtrip(store):
     got = store.get("chunks", "u")
     assert got.text == "héllo 世界 🚀"
     assert got.metadata == {"λ": "µ"}
+
+
+def test_interpolate_is_quote_aware():
+    """%s inside a '...' CQL string literal is NOT a placeholder, literal
+    % never raises, '' stays inside the literal, and placeholder/param
+    count mismatches raise instead of corrupting the statement."""
+    import numpy as np
+    import pytest
+
+    from githubrepostorag_tpu.store.cql import cql_literal, interpolate
+
+    assert (
+        interpolate("UPDATE t SET note = '50%savings' WHERE row_id = %s", ("r1",))
+        == "UPDATE t SET note = '50%savings' WHERE row_id = 'r1'"
+    )
+    assert (
+        interpolate("x = %s AND y = '%s it''s %s' AND z = %s", (1, 2))
+        == "x = 1 AND y = '%s it''s %s' AND z = 2"
+    )
+    with pytest.raises(ValueError):
+        interpolate("SELECT * FROM t WHERE v LIKE '%sql%'", ("extra",))
+    with pytest.raises(ValueError):
+        interpolate("a %s %s", ("one",))
+    with pytest.raises(ValueError):  # empty params must not skip validation
+        interpolate("DELETE FROM t WHERE row_id = %s", [])
+    assert interpolate("SELECT * FROM t", None) == "SELECT * FROM t"
+    # numpy scalars render as plain CQL numbers (numpy-2.x repr is not CQL)
+    assert cql_literal(np.float64(1.5)) == "1.5"
+    assert cql_literal([np.float64(1.5), np.int32(2)]) == "[1.5, 2]"
+    assert cql_literal(["a", "b'c"]) == "['a', 'b''c']"
