@@ -231,6 +231,11 @@ class Layered4(NamedTuple):
     s: jnp.ndarray  # [L, n_g, out] bf16
     zs: jnp.ndarray  # [L, n_g, out] bf16
     layer: jnp.ndarray  # scalar int32
+    # W4A8 routing hint: None = auto (decode-sized batches take the MXU
+    # int8 path), False = force exact bf16-dequant — the prefill/verify
+    # paths pin False so an engine whose prefill_chunk is decode-sized
+    # never silently relaxes the prompt-processing accuracy contract
+    w4a8: bool | None = None
 
 
 class Layered4XLA(NamedTuple):
@@ -251,14 +256,17 @@ def _use_pallas_int4() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def q4_dispatch(x, q, s, zs, layer=None, out_dtype=None, kernel: bool = True):
+def q4_dispatch(x, q, s, zs, layer=None, out_dtype=None, kernel: bool = True,
+                w4a8: bool | None = None):
     """THE int4 matmul router (every consumer — qmatmul, _logits — goes
-    through here): Pallas in-VMEM-dequant GEMM on TPU when ``kernel``,
-    else the two-dot XLA formulation."""
+    through here): Pallas GEMM on TPU when ``kernel`` (W4A8 MXU-int8 route
+    for decode-sized batches, exact bf16-dequant otherwise — see
+    ``int4_matmul``), else the two-dot XLA formulation."""
     if kernel and _use_pallas_int4():
         from githubrepostorag_tpu.ops.pallas_int4 import int4_matmul
 
-        return int4_matmul(x, q, s, zs, layer=layer, out_dtype=out_dtype)
+        return int4_matmul(x, q, s, zs, layer=layer, out_dtype=out_dtype,
+                           w4a8=w4a8)
     if layer is not None:
         sl = lambda a: jax.lax.dynamic_index_in_dim(a, layer, 0, keepdims=False)
         q, s, zs = sl(q), sl(s), sl(zs)
@@ -276,16 +284,20 @@ def _split_q4(layers: dict) -> tuple[dict, dict]:
     return rest, q4
 
 
-def _with_layered_q4(p: dict, q4_stacks: dict, layer, kernel: bool = True) -> dict:
+def _with_layered_q4(p: dict, q4_stacks: dict, layer, kernel: bool = True,
+                     w4a8: bool | None = None) -> dict:
     """Per-layer param dict = sliced leaves + Layered4 views at ``layer``.
     ``kernel=False`` (TP-sharded weights) builds the XLA-route twin —
-    see Layered4XLA."""
+    see Layered4XLA.  ``w4a8`` is the routing hint carried into each view
+    (decode burst: auto; prefill/verify: False)."""
     if not q4_stacks:
         return p
-    view = Layered4 if kernel else Layered4XLA
     out = dict(p)
     for k, v in q4_stacks.items():
-        out[k] = view(q=v.q, s=v.s, zs=v.zs, layer=layer)
+        if kernel:
+            out[k] = Layered4(q=v.q, s=v.s, zs=v.zs, layer=layer, w4a8=w4a8)
+        else:
+            out[k] = Layered4XLA(q=v.q, s=v.s, zs=v.zs, layer=layer)
     return out
 
 
@@ -299,7 +311,7 @@ def qmatmul(x: jnp.ndarray, w) -> jnp.ndarray:
     two-dot XLA formulation (q4_matmul), which is also the kernel's
     correctness oracle."""
     if isinstance(w, Layered4):
-        return q4_dispatch(x, w.q, w.s, w.zs, layer=w.layer)
+        return q4_dispatch(x, w.q, w.s, w.zs, layer=w.layer, w4a8=w.w4a8)
     if isinstance(w, Layered4XLA):
         return q4_dispatch(x, w.q, w.s, w.zs, layer=w.layer, kernel=False)
     if isinstance(w, QuantizedLinear4):

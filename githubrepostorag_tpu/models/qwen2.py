@@ -471,7 +471,10 @@ def forward_paged_impl(
         else:
             p, kp, vp = layer_xs
             ks = vs = None
-        p = _with_layered_q4(p, q4_stacks, li, kernel=int4_kernel)
+        # prefill / spec-verify chunks pin w4a8=False: prompt processing
+        # keeps the exact bf16-dequant contract even when the chunk is
+        # decode-sized (the auto gate must never catch a prefill batch)
+        p = _with_layered_q4(p, q4_stacks, li, kernel=int4_kernel, w4a8=False)
 
         def attend(q, k, v):
             # [n_kv, P*ps, hd] flat view; one slot vector shared by all heads

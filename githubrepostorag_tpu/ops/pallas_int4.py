@@ -115,6 +115,192 @@ def _int4_kernel(*refs, half: int, n_gt: int, layered: bool, sliced: bool):
         out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
+def _w4a8_kernel(*refs, half: int, n_gt: int, layered: bool, sliced: bool):
+    """W4A8 tile: nibbles->int8 on the VPU's cheap integer path, the dots
+    on the MXU's NATIVE int8 path (int8 x int8 -> int32), one dot per
+    weight GROUP so each int32 partial picks up its own group scale at
+    f32 accumulation.  Versus the bf16-dequant kernel (_int4_kernel) the
+    per-weight-element VPU work drops from mask+cast+scale+subtract in
+    bf16 to mask/shift+int8-cast — the group-scale multiply runs on the
+    [MT, OT] partial (1/gsz of the weight elements per group) and the
+    zero-point term leaves the kernel entirely (wrapper-side XLA dot).
+
+    Accuracy contract: activations are quantized per token row to
+    symmetric int8 (the wrapper's x/amax*127), so results differ from the
+    bf16-dequant math by the activation-quant error (~1e-2 relative) —
+    gated by parity tests mirroring int8's (tests/test_quant4.py)."""
+    ii = pl.program_id(2)
+    n_ii = pl.num_programs(2)
+    if layered:
+        (_li_ref, xa_ref, xb_ref, q_ref, s_ref, out_ref, acc_ref) = refs
+        pq = q_ref[0]  # [IT/2, OT] uint8
+        s = s_ref[0, pl.ds(ii * n_gt, n_gt)] if sliced else s_ref[0]
+    else:
+        (xa_ref, xb_ref, q_ref, s_ref, out_ref, acc_ref) = refs
+        pq = q_ref[...]
+        s = s_ref[pl.ds(ii * n_gt, n_gt)] if sliced else s_ref[...]
+
+    @pl.when(ii == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ot = pq.shape[-1]
+    # int32 widen (Mosaic legalizes neither uint8 shifts nor narrow
+    # casts), then straight to int8 nibble values — no bf16 anywhere
+    pq32 = pq.astype(jnp.int32)
+    lo8 = (pq32 & 0x0F).astype(jnp.int8).reshape(n_gt, half, ot)
+    hi8 = (pq32 >> 4).astype(jnp.int8).reshape(n_gt, half, ot)
+    s_f = s.astype(jnp.float32)  # [n_gt, OT]
+    dn = (((1,), (0,)), ((), ()))
+    for g in range(n_gt):  # static unroll: n_gt <= 16 by tile choice
+        pa = jax.lax.dot_general(
+            xa_ref[g], lo8[g], dn, preferred_element_type=jnp.int32
+        )
+        pb = jax.lax.dot_general(
+            xb_ref[g], hi8[g], dn, preferred_element_type=jnp.int32
+        )
+        acc_ref[...] += (pa + pb).astype(jnp.float32) * s_f[g][None, :]
+
+    @pl.when(ii == n_ii - 1)
+    def _():
+        out_ref[...] = acc_ref[...]
+
+
+def _tiles_and_maps(in_dim: int, out: int, gsz: int, n_g: int,
+                    layered: bool, layer):
+    """Tile sizes + (q, s) block specs shared by both int4 routes: the
+    in-tile is a multiple of 8 GROUPS (scale slice offsets must be provable
+    sublane multiples; single in-tile when it falls back to the whole input
+    dim), and stacked weights address (layer, tile) through the prefetched
+    scalar so the layer loop never materializes a per-layer copy."""
+    it = _pick_tile(in_dim, gsz * 8, 1024)
+    # VMEM budget: unpacked w tile + packed tile + f32 acc
+    ot = _pick_tile(out, 1, max(512, (3 * 2**20) // (2 * it)))
+    n_gt = it // gsz
+
+    def out_map(mi, oi, ii, *refs):
+        return (mi, oi)
+
+    if layered:
+        def q_map(mi, oi, ii, li):
+            return (li[0], ii, oi)
+
+        def s_map(mi, oi, ii, li):
+            return (li[0], 0, oi)
+
+        q_block = (1, it // 2, ot)
+        s_block = (1, n_g, ot)
+        scalars = [jnp.reshape(layer, (1,)).astype(jnp.int32)]
+    else:
+        def q_map(mi, oi, ii, *refs):
+            return (ii, oi)
+
+        def s_map(mi, oi, ii, *refs):
+            return (0, oi)
+
+        q_block = (it // 2, ot)
+        s_block = (n_g, ot)
+        scalars = []
+    return it, ot, n_gt, out_map, q_map, s_map, q_block, s_block, scalars
+
+
+def _w4a8_matmul(x, q, s, zs, layer, out_dtype, interpret: bool):
+    """The W4A8 route of ``int4_matmul`` (decode-sized batches).  The
+    wrapper quantizes activations to per-row int8, lays them out
+    group-major ([n_g, M, half] per nibble plane — static leading-axis
+    indexing; in-kernel lane slicing at half-multiples is not
+    128-aligned), and folds the zero-point term into one small XLA dot:
+
+        y[m,o] = sxn[m] * (Sum_g s[g,o]*P[g,m,o] - Sum_g R[m,g]*zs[g,o])
+
+    with P the kernel's int32 group partials, R the per-group sums of the
+    quantized activations, sxn = rowmax|x|/127."""
+    layered = q.ndim == 3
+    if layered:
+        assert layer is not None, "stacked int4 weights need the layer index"
+    lead = x.shape[:-1]
+    in_dim = x.shape[-1]
+    out = q.shape[-1]
+    n_g = s.shape[-2]
+    gsz = in_dim // n_g
+    half = gsz // 2
+    out_dtype = out_dtype or x.dtype
+
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, in_dim)
+    # per-row symmetric int8 activation quant (f32 math: bf16 rounding
+    # would double-quantize)
+    xf = x2.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)  # [m, 1]
+    sxn = amax / 127.0
+    xq = jnp.where(
+        amax > 0, jnp.round(xf * (127.0 / jnp.maximum(amax, 1e-30))), 0.0
+    ).astype(jnp.int8)
+
+    # zero-point term in XLA: R[m, g] = sum of xq over the group
+    r = xq.reshape(m, n_g, gsz).sum(axis=-1, dtype=jnp.int32)
+    zsl = zs
+    if layered:
+        zsl = jax.lax.dynamic_index_in_dim(zs, layer, 0, keepdims=False)
+    zs_term = jax.lax.dot_general(
+        r.astype(jnp.float32), zsl.astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+    )  # [m, out]
+
+    # group-major nibble-plane layout for the kernel
+    xg = xq.reshape(m, n_g, gsz)
+    xa = jnp.transpose(xg[:, :, :half], (1, 0, 2))  # [n_g, m, half]
+    xb = jnp.transpose(xg[:, :, half:], (1, 0, 2))
+    m_padded = -(-m // 8) * 8
+    mt = m_padded
+    if m_padded != m:
+        xa = jnp.pad(xa, ((0, 0), (0, m_padded - m), (0, 0)))
+        xb = jnp.pad(xb, ((0, 0), (0, m_padded - m), (0, 0)))
+
+    it, ot, n_gt, out_map, q_map, s_map, q_block, s_block, scalars = \
+        _tiles_and_maps(in_dim, out, gsz, n_g, layered, layer)
+    grid = (m_padded // mt, out // ot, in_dim // it)
+
+    def x_map(mi, oi, ii, *refs):
+        return (ii, mi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(scalars),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n_gt, mt, half), x_map),
+            pl.BlockSpec((n_gt, mt, half), x_map),
+            pl.BlockSpec(q_block, q_map),
+            pl.BlockSpec(s_block, s_map),
+        ],
+        out_specs=pl.BlockSpec((mt, ot), out_map),
+        scratch_shapes=[pltpu.VMEM((mt, ot), jnp.float32)],
+    )
+    kernel = functools.partial(
+        _w4a8_kernel, half=half, n_gt=n_gt, layered=layered,
+        sliced=in_dim // it > 1,
+    )
+    acc = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_padded, out), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*scalars, xa, xb, q, s)
+    y = sxn * (acc[:m] - zs_term)
+    return y.astype(out_dtype).reshape(*lead, out)
+
+
+def _w4a8_enabled() -> bool:
+    import os
+
+    return os.environ.get("INT4_W4A8", "1") != "0"
+
+
 def int4_matmul(
     x: jnp.ndarray,  # [..., IN]
     q: jnp.ndarray,  # [IN/2, OUT] or [L, IN/2, OUT] uint8 (in-group packed)
@@ -123,9 +309,23 @@ def int4_matmul(
     layer: jnp.ndarray | None = None,  # scalar int32, REQUIRED when stacked
     out_dtype=None,  # default x.dtype; jnp.float32 for logits
     interpret: bool = False,
+    w4a8: bool | None = None,  # None: W4A8 for decode-sized batches unless
+    # INT4_W4A8=0 — the MXU-int8 route is what makes 4-bit FASTER than
+    # int8 instead of VPU-dequant-bound (accuracy contract: + per-row
+    # int8 activation quant, ~1e-2 relative)
 ) -> jnp.ndarray:
     """``x @ dequant(q, s, zs)`` with the dequant in VMEM.  Returns
     [..., OUT] in ``out_dtype``."""
+    m = 1
+    for d in x.shape[:-1]:
+        m *= d
+    if w4a8 is None:
+        # decode-sized rows only: prefill stays on exact bf16-dequant (it
+        # is MXU-compute-bound there, and the f32 [m, out] partial would
+        # be large), so prompt processing keeps the stricter contract
+        w4a8 = m <= 256 and _w4a8_enabled()
+    if w4a8:
+        return _w4a8_matmul(x, q, s, zs, layer, out_dtype, interpret)
     layered = q.ndim == 3
     if layered:
         assert layer is not None, "stacked int4 weights need the layer index"
@@ -160,42 +360,12 @@ def int4_matmul(
         xa = jnp.pad(xa, ((0, m_padded - m), (0, 0)))
         xb = jnp.pad(xb, ((0, m_padded - m), (0, 0)))
 
-    # in-tile: a multiple of 8 GROUPS (so the scale slice offset is a
-    # provable sublane multiple), falling back to the whole input dim
-    # (single in-tile, no slicing)
-    it = _pick_tile(in_dim, gsz * 8, 1024)
-    # VMEM budget: dequantized w tile (bf16) + packed tile + acc
-    ot = _pick_tile(out, 1, max(512, (3 * 2**20) // (2 * it)))
-    n_gt = it // gsz
-
+    it, ot, n_gt, out_map, q_map, s_map, q_block, s_block, scalars = \
+        _tiles_and_maps(in_dim, out, gsz, n_g, layered, layer)
     grid = (m_padded // mt, out // ot, in_dim // it)
 
     def x_map(mi, oi, ii, *refs):
         return (mi, ii)
-
-    def out_map(mi, oi, ii, *refs):
-        return (mi, oi)
-
-    if layered:
-        def q_map(mi, oi, ii, li):
-            return (li[0], ii, oi)
-
-        def s_map(mi, oi, ii, li):
-            return (li[0], 0, oi)
-
-        q_block = (1, it // 2, ot)
-        s_block = (1, n_g, ot)
-        scalars = [jnp.reshape(layer, (1,)).astype(jnp.int32)]
-    else:
-        def q_map(mi, oi, ii, *refs):
-            return (ii, oi)
-
-        def s_map(mi, oi, ii, *refs):
-            return (0, oi)
-
-        q_block = (it // 2, ot)
-        s_block = (n_g, ot)
-        scalars = []
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(scalars),

@@ -1,0 +1,92 @@
+"""Regenerate README.md's benchmark table from BENCH_SUMMARY.json.
+
+VERDICT r03 "next" #8: README perf prose drifted from the driver artifacts
+two rounds running.  bench.py now writes every record to BENCH_SUMMARY.json
+(see bench.finish()); this script rewrites the block between the
+PERF_TABLE_START/END markers from those records, so the table can never
+disagree with the evidence.  Run after a bench: ``python
+scripts/readme_perf_table.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+START = "<!-- PERF_TABLE_START"
+END = "<!-- PERF_TABLE_END -->"
+
+
+def fmt(v: float) -> str:
+    return f"{v:,.0f}" if v >= 10 else f"{v:.2f}"
+
+
+def row(label: str, summary: dict, keys: list[str], unit: str,
+        vs: dict, extras: dict) -> str | None:
+    vals = [summary.get(k) for k in keys]
+    if all(v is None for v in vals):
+        return None
+    meas = " / ".join("—" if v is None else fmt(v) for v in vals) + f" {unit}"
+    vsb = [vs.get(k) for k in keys]
+    vstxt = " / ".join("—" if v is None else f"{v:.2f}×" for v in vsb)
+    roof = [extras.get(k, {}).get("roofline_pct") for k in keys]
+    if any(r is not None for r in roof):
+        vstxt += " (" + "/".join("—" if r is None else f"{r:.0f}%" for r in roof) \
+                 + " of HBM roofline)"
+    return f"| {label} | {meas} | {vstxt} |"
+
+
+def build_table(records: list[dict]) -> str:
+    summary = {r["metric"]: r["value"] for r in records}
+    vs = {r["metric"]: r["vs_baseline"] for r in records}
+    extras = {r["metric"]: r for r in records}
+    rows = [
+        row("Qwen2-7B int8 decode, bs=32 (flagship)", summary,
+            ["decode_tok_s_per_chip_qwen2-7b_int8_bs32"], "tok/s", vs, extras),
+        row("Qwen2-7B int4 (W4A8) decode, bs=32", summary,
+            ["decode_tok_s_per_chip_qwen2-7b_int4_bs32"], "tok/s", vs, extras),
+        row("Qwen2-7B int8, 64 concurrent streams (agg / p50 TTFT s)", summary,
+            ["concurrent64_agg_tok_s_qwen2-7b_int8",
+             "concurrent64_p50_ttft_qwen2-7b_int8"], "", vs, extras),
+        row("Qwen2-0.5B decode, bs=8", summary,
+            ["decode_tok_s_per_chip_qwen2-0.5b_bs8"], "tok/s", vs, extras),
+        row("Qwen2-1.5B decode, bs=8 / bs=32", summary,
+            ["decode_tok_s_per_chip_qwen2-1.5b_bs8",
+             "decode_tok_s_per_chip_qwen2-1.5b_bs32"], "tok/s", vs, extras),
+        row("64 concurrent streams agg (0.5B / 1.5B)", summary,
+            ["concurrent64_agg_tok_s_qwen2-0.5b",
+             "concurrent64_agg_tok_s_qwen2-1.5b"], "tok/s", vs, extras),
+        row("Prefix cache warm/cold TTFT ratio (1.5B, 3.5k prefix)", summary,
+            ["prefix_cache_warm_over_cold_qwen2-1.5b"], "", vs, extras),
+        row("Spec decode speedup vs burst (0.5B / 1.5B)", summary,
+            ["spec_decode_speedup_vs_burst_bs1",
+             "spec_decode_speedup_vs_burst_bs1_qwen2-1.5b"], "×", vs, extras),
+        row("KV-quant capacity regime agg (0.5B)", summary,
+            ["concurrent64_agg_tok_s_qwen2-0.5b_kvquant_int8"], "tok/s", vs, extras),
+        row("1k-doc extractor batch (0.5B)", summary,
+            ["extractor_batch1k_docs_s_qwen2-0.5b"], "docs/s", vs, extras),
+        row("Embedding (e5-small geometry)", summary,
+            ["embed_chunks_s_e5-small"], "chunks/s", vs, extras),
+    ]
+    head = ("<!-- PERF_TABLE_START (generated: python "
+            "scripts/readme_perf_table.py — do not hand-edit rows) -->\n"
+            "| Metric | Measured | vs target |\n|---|---|---|")
+    return "\n".join([head] + [r for r in rows if r] + [END])
+
+
+def main() -> int:
+    summary_path = ROOT / "BENCH_SUMMARY.json"
+    readme_path = ROOT / "README.md"
+    data = json.loads(summary_path.read_text())
+    text = readme_path.read_text()
+    i = text.index(START)
+    j = text.index(END) + len(END)
+    readme_path.write_text(text[:i] + build_table(data["records"]) + text[j:])
+    print(f"README table regenerated from {len(data['records'])} records")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

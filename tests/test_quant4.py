@@ -225,8 +225,9 @@ def test_pallas_int4_matmul_matches_oracle():
             ref = q4_matmul(x, QuantizedLinear4(sl(qt.q), sl(qt.s), sl(qt.zs)))
             got_l = int4_matmul(x, qt.q, qt.s, qt.zs,
                                 layer=jnp.asarray(li, dtype=jnp.int32),
-                                interpret=True)
-            got_u = int4_matmul(x, sl(qt.q), sl(qt.s), sl(qt.zs), interpret=True)
+                                interpret=True, w4a8=False)
+            got_u = int4_matmul(x, sl(qt.q), sl(qt.s), sl(qt.zs),
+                                interpret=True, w4a8=False)
             np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref),
                                        rtol=2e-2, atol=1e-4)
             np.testing.assert_allclose(np.asarray(got_u), np.asarray(ref),
@@ -243,6 +244,85 @@ def test_pallas_int4_matmul_3d_batch_and_f32_out():
     qt = quantize_weight4(w, group_size=G)
     x = jnp.asarray(rng.normal(size=(2, 3, IN)), dtype=jnp.float32)
     ref = q4_matmul(x, qt, preferred=jnp.float32)
-    got = int4_matmul(x, qt.q, qt.s, qt.zs, out_dtype=jnp.float32, interpret=True)
+    got = int4_matmul(x, qt.q, qt.s, qt.zs, out_dtype=jnp.float32, interpret=True,
+                      w4a8=False)
     assert got.shape == (2, 3, OUT) and got.dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-2, atol=1e-4)
+
+
+def _w4a8_oracle(x, qt, group_size):
+    """XLA re-statement of the W4A8 math (ops/pallas_int4.py::_w4a8_matmul):
+    per-row symmetric int8 activations, int32 group dots against the nibble
+    values, group scales applied to the f32 partials, zero-point via the
+    group row-sums.  Bit-for-bit the kernel's quantization decisions, so the
+    interpret-mode comparison is tight."""
+    m, in_dim = x.shape
+    n_g = qt.s.shape[-2]
+    gsz = in_dim // n_g
+    assert gsz == group_size
+    xf = np.asarray(x, dtype=np.float32)
+    amax = np.abs(xf).max(axis=-1, keepdims=True)
+    sxn = amax / 127.0
+    with np.errstate(invalid="ignore"):
+        xq = np.where(amax > 0, np.round(xf * (127.0 / np.maximum(amax, 1e-30))), 0.0)
+    xq = xq.astype(np.int32)
+    # unpack nibbles to int values, grouped [n_g, gsz, out]
+    half = gsz // 2
+    pg = np.asarray(qt.q).reshape(n_g, half, -1).astype(np.int32)
+    w_int = np.concatenate([pg & 0xF, pg >> 4], axis=1)  # [n_g, gsz, out]
+    s = np.asarray(qt.s, dtype=np.float32)
+    zs = np.asarray(qt.zs, dtype=np.float32)
+    xg = xq.reshape(m, n_g, gsz)
+    p = np.einsum("mgj,gjo->gmo", xg, w_int)  # int32 partials
+    acc = np.einsum("gmo,go->mo", p.astype(np.float32), s)
+    r = xg.sum(axis=-1).astype(np.float32)  # [m, n_g]
+    return sxn * (acc - r @ zs)
+
+
+def test_w4a8_matches_oracle_and_reference():
+    """The W4A8 route (interpret mode) must match the numpy oracle tightly
+    (same integer math) and the exact bf16-dequant reference within the
+    activation-quant tolerance — the documented accuracy-contract change."""
+    from githubrepostorag_tpu.models.quant import q4_matmul
+    from githubrepostorag_tpu.ops.pallas_int4 import int4_matmul
+
+    rng = np.random.default_rng(11)
+    IN, OUT, L = 64, 48, 3
+    w = jnp.asarray(rng.normal(0, 0.02, (L, IN, OUT)), dtype=jnp.float32)
+    qt = quantize_weight4(w, group_size=G)
+    for m in (1, 5, 8):
+        x = jnp.asarray(rng.normal(size=(m, IN)), dtype=jnp.float32)
+        for li in (0, 2):
+            sl = lambda a: a[li]
+            oracle = _w4a8_oracle(x, QuantizedLinear4(sl(qt.q), sl(qt.s), sl(qt.zs)), G)
+            ref = q4_matmul(x, QuantizedLinear4(sl(qt.q), sl(qt.s), sl(qt.zs)))
+            got_l = int4_matmul(x, qt.q, qt.s, qt.zs,
+                                layer=jnp.asarray(li, dtype=jnp.int32),
+                                interpret=True, w4a8=True)
+            got_u = int4_matmul(x, sl(qt.q), sl(qt.s), sl(qt.zs),
+                                interpret=True, w4a8=True)
+            for got in (got_l, got_u):
+                # oracle: same int math, bf16 scale storage is shared — only
+                # f32 summation order differs
+                np.testing.assert_allclose(np.asarray(got), oracle,
+                                           rtol=1e-4, atol=1e-5)
+                # reference: differs by the per-row int8 activation quant
+                np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                           rtol=5e-2, atol=5e-3)
+
+
+def test_w4a8_3d_batch_f32_out_and_zero_rows():
+    from githubrepostorag_tpu.ops.pallas_int4 import int4_matmul
+
+    rng = np.random.default_rng(12)
+    IN, OUT = 32, 64
+    w = jnp.asarray(rng.normal(0, 0.02, (IN, OUT)), dtype=jnp.float32)
+    qt = quantize_weight4(w, group_size=G)
+    x = jnp.asarray(rng.normal(size=(2, 3, IN)), dtype=jnp.float32)
+    x = x.at[0, 1].set(0.0)  # an all-zero row must not divide by zero
+    oracle = _w4a8_oracle(x.reshape(6, IN), qt, G).reshape(2, 3, OUT)
+    got = int4_matmul(x, qt.q, qt.s, qt.zs, out_dtype=jnp.float32,
+                      interpret=True, w4a8=True)
+    assert got.shape == (2, 3, OUT) and got.dtype == jnp.float32
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), oracle, rtol=1e-4, atol=1e-5)
