@@ -107,11 +107,24 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float | None,
 
 def decode_extras(tps: float, batch: int, weight_bytes: int) -> dict:
     """Achieved HBM GB/s and %-of-roofline for a decode metric: each decode
-    step reads the full weight tree once, so steps/s x weight bytes is the
-    weight-stream bandwidth actually sustained."""
+    step reads the streamed weight bytes once, so steps/s x weight bytes is
+    the weight-stream bandwidth actually sustained."""
     gbps = tps / batch * weight_bytes / 1e9
     return {"hbm_gbps": round(gbps, 1),
             "roofline_pct": round(100.0 * gbps / HBM_GBPS_V5E, 1)}
+
+
+def streamed_nbytes(params) -> int:
+    """Weight bytes a decode step actually STREAMS: the full tree minus the
+    input-embedding table when an untied lm_head exists (decode only
+    gathers B rows of it; a tied table is the logits operand and does
+    stream every step)."""
+    from githubrepostorag_tpu.models.quant import params_nbytes
+
+    total = params_nbytes(params)
+    if params.get("lm_head") is not None:
+        total -= params_nbytes(params["embed"])
+    return total
 
 
 # priority order for the FINAL line the driver's last-line parse lands on
@@ -164,8 +177,13 @@ def bench_decode(cfg, tag: str, *, batch: int, prompt_len: int, gen_tokens: int,
     from githubrepostorag_tpu.serving.sampling_params import SamplingParams
 
     if params is None:
-        log(f"bench[{tag}]: init params (bf16)")
-        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+        from githubrepostorag_tpu.models.quant import fuse_projections
+
+        log(f"bench[{tag}]: init params (bf16, fused serving layout)")
+        params = fuse_projections(
+            init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16),
+            in_place=True,  # solely owned: no transient double layout
+        )
         jax.block_until_ready(params)
     use_pallas = jax.default_backend() == "tpu"
     prompts = _prompts(batch, prompt_len, cfg.vocab_size)
@@ -372,7 +390,7 @@ def bench_7b(bits: int, keep_params: bool = False):
     tag = f"qwen2-7b-int{bits}"
     log(f"bench[{tag}]: building host-side int{bits} params "
         f"(transfer ~{2 if bits == 4 else 4} min through the tunnel)")
-    params = init_params_quantized(cfg, bits=bits)
+    params = init_params_quantized(cfg, bits=bits, fuse=True)
     jax.block_until_ready(params)
     log(f"bench[{tag}]: {params_nbytes(params) / 1e9:.2f} GB on chip; compiling")
     # burst 32 (not 64): the 7B burst program's XLA compile time scales
@@ -385,7 +403,7 @@ def bench_7b(bits: int, keep_params: bool = False):
                              gen_tokens=96, num_pages=160, page_size=256,
                              max_seq=1024, params=params, decode_burst=32,
                              runs=1)
-    nbytes = params_nbytes(params)
+    nbytes = streamed_nbytes(params)
     if keep_params:  # eval config #5 reuses the resident tree (the 7B
         # host->device transfer is the bench's most fragile phase)
         return tps, nbytes, params, cfg
@@ -432,7 +450,7 @@ def _main() -> None:
     tps, _, params05 = bench_decode(cfg05, "qwen2-0.5b", batch=8, prompt_len=128,
                                     gen_tokens=256, num_pages=64, page_size=256,
                                     max_seq=1024, decode_burst=128)
-    nbytes05 = params_nbytes(params05)
+    nbytes05 = streamed_nbytes(params05)
     emit("decode_tok_s_per_chip_qwen2-0.5b_bs8", tps, "tok/s", tps / BASELINE_TOK_S,
          **decode_extras(tps, 8, nbytes05))
 
@@ -482,7 +500,7 @@ def _main() -> None:
                                           decode_burst=128)
         emit("decode_tok_s_per_chip_qwen2-1.5b_bs8", tps15, "tok/s",
              tps15 / BASELINE_TOK_S,
-             **decode_extras(tps15, 8, params_nbytes(params15)))
+             **decode_extras(tps15, 8, streamed_nbytes(params15)))
     if params15 is not None and budget_allows("qwen2-1.5b-bs32", 120):
         # decode is weight-read bound: bs=32 measures ~2.6x bs=8 on one chip
         tps15b, _, _ = bench_decode(cfg15, "qwen2-1.5b-bs32", batch=32,
@@ -491,7 +509,7 @@ def _main() -> None:
                                     runs=2, params=params15, decode_burst=32)
         emit("decode_tok_s_per_chip_qwen2-1.5b_bs32", tps15b, "tok/s",
              tps15b / BASELINE_TOK_S,
-             **decode_extras(tps15b, 32, params_nbytes(params15)))
+             **decode_extras(tps15b, 32, streamed_nbytes(params15)))
 
     # ---- prefix caching in its stated regime: 3.5k-token prefix, 1.5B ----
     # (VERDICT r02 #4: prove warm TTFT < 0.7x cold where prefill dominates)
@@ -556,7 +574,12 @@ def _main() -> None:
             log("bench: re-init 0.5B params for the remaining items")
             from githubrepostorag_tpu.models.qwen2 import init_params
 
-            params05 = init_params(cfg05, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+            from githubrepostorag_tpu.models.quant import fuse_projections
+
+            params05 = fuse_projections(
+                init_params(cfg05, jax.random.PRNGKey(0), dtype=jnp.bfloat16),
+                in_place=True,
+            )
             jax.block_until_ready(params05)
         return params05
 

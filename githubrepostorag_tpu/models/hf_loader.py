@@ -136,6 +136,7 @@ def load_qwen2(
     dtype=np.float32,
     quantize: bool | int = False,
     moe_capacity_factor: float = 2.0,
+    fuse: bool = False,
 ) -> tuple[dict, Qwen2Config]:
     """Load config.json + *.safetensors from a local directory.
 
@@ -171,12 +172,20 @@ def load_qwen2(
                 "checkpoint %s is natively 4-bit AWQ; ignoring the int8 "
                 "quantize request and repacking the AWQ codes", checkpoint_dir
             )
-        return awq_params_from_state_dict(state, cfg, hf_cfg, dtype=dtype), cfg
-    params = params_from_state_dict(state, cfg, dtype=dtype)
-    if quantize:
-        from githubrepostorag_tpu.models.quant import quantize_qwen2_params
+        params = awq_params_from_state_dict(state, cfg, hf_cfg, dtype=dtype)
+    else:
+        params = params_from_state_dict(state, cfg, dtype=dtype)
+        if quantize:
+            from githubrepostorag_tpu.models.quant import quantize_qwen2_params
 
-        params = quantize_qwen2_params(params, bits=4 if quantize == 4 else 8)
+            params = quantize_qwen2_params(params, bits=4 if quantize == 4 else 8)
+    if fuse:
+        # single-chip serving layout (quant.fuse_projections): fuse at load
+        # time, while the tree is the only thing on the device, rather than
+        # at Engine construction next to freshly allocated KV pools
+        from githubrepostorag_tpu.models.quant import fuse_projections
+
+        params = fuse_projections(params, in_place=True)  # solely owned here
     return params, cfg
 
 

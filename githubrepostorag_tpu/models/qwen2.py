@@ -187,9 +187,16 @@ def _block(cfg: Qwen2Config, h, p, cos, sin, attend, reduce=None):
         reduce = lambda x: x
 
     hn = rms_norm(h, p["ln1"], cfg.rms_norm_eps)
-    q = (qmatmul(hn, p["wq"]) + p["bq"]).reshape(b, s, nq, hd)
-    k = (qmatmul(hn, p["wk"]) + p["bk"]).reshape(b, s, nkv, hd)
-    v = (qmatmul(hn, p["wv"]) + p["bv"]).reshape(b, s, nkv, hd)
+    if "wqkv" in p:  # fused single-chip serving layout (quant.fuse_projections)
+        qkv = qmatmul(hn, p["wqkv"]) + p["bqkv"]
+        q, k, v = jnp.split(qkv, [nq * hd, (nq + nkv) * hd], axis=-1)
+        q = q.reshape(b, s, nq, hd)
+        k = k.reshape(b, s, nkv, hd)
+        v = v.reshape(b, s, nkv, hd)
+    else:
+        q = (qmatmul(hn, p["wq"]) + p["bq"]).reshape(b, s, nq, hd)
+        k = (qmatmul(hn, p["wk"]) + p["bk"]).reshape(b, s, nkv, hd)
+        v = (qmatmul(hn, p["wv"]) + p["bv"]).reshape(b, s, nkv, hd)
     q, k = apply_rope(q, k, cos, sin)
 
     attn, cache_info = attend(q, k, v)
@@ -200,6 +207,9 @@ def _block(cfg: Qwen2Config, h, p, cos, sin, attend, reduce=None):
         from githubrepostorag_tpu.models.moe import moe_mlp
 
         h = h + moe_mlp(cfg, p, hn)
+    elif "wgu" in p:  # fused gate|up (quant.fuse_projections)
+        g, u = jnp.split(qmatmul(hn, p["wgu"]), 2, axis=-1)
+        h = h + reduce(qmatmul(jax.nn.silu(g) * u, p["wd"]))
     else:
         h = h + reduce(
             qmatmul(jax.nn.silu(qmatmul(hn, p["wg"])) * qmatmul(hn, p["wu"]), p["wd"])
@@ -323,7 +333,8 @@ def _embed_dtype(params: dict):
     return params["norm"].dtype
 
 
-def _logits(params: dict, h: jnp.ndarray, int4_kernel: bool = True) -> jnp.ndarray:
+def _logits(params: dict, h: jnp.ndarray, int4_kernel: bool = True,
+            w4a8: bool | None = None) -> jnp.ndarray:
     """Final projection -> float32 logits (tied embedding or separate
     lm_head).  Operands stay in their stored dtype (bf16 on the MXU) with
     float32 accumulation via preferred_element_type — an explicit astype
@@ -349,7 +360,8 @@ def _logits(params: dict, h: jnp.ndarray, int4_kernel: bool = True) -> jnp.ndarr
         from githubrepostorag_tpu.models.quant import q4_dispatch
 
         return q4_dispatch(h, lm_head.q, lm_head.s, lm_head.zs,
-                           out_dtype=jnp.float32, kernel=int4_kernel)
+                           out_dtype=jnp.float32, kernel=int4_kernel,
+                           w4a8=w4a8)
     if isinstance(lm_head, QuantizedLinear):
         # dequantized per use; the convert+scale fuses into the dot
         wd = dequant_weight(lm_head, h.dtype)
@@ -523,7 +535,10 @@ def forward_paged_impl(
     h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
     if logits_at is not None:
         h = jnp.take_along_axis(h, logits_at[:, None, None], axis=1)  # [B, 1, d]
-    logits = _logits(params, h, int4_kernel=int4_kernel)
+    # w4a8=False: prefill/spec-verify logits keep the exact bf16-dequant
+    # contract, like the projections above (the prompt's first sampled
+    # token and every verify accept/reject come from these)
+    logits = _logits(params, h, int4_kernel=int4_kernel, w4a8=False)
     if quant:
         return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages

@@ -45,17 +45,23 @@ async def serve(host: str, port: int) -> None:
         "loading weights from %s%s", s.model_weights_path,
         f" (int{s.quantize_weights} weight-only)" if s.quantize_weights else "",
     )
-    params, cfg = load_qwen2(
-        s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights,
+    n = len(jax.devices())
+    # Plan the mesh from config.json ALONE, before any weights move: the
+    # plan decides both the sharding below and whether load_qwen2 should
+    # pre-fuse the projection weights (single-chip serving layout) while
+    # the tree is the only thing on the device — one source of truth for
+    # both decisions.  MESH_SHAPE overrides the automatic plan (vLLM's
+    # --tensor-parallel-size equivalent; reference runs TP=1 on one GPU —
+    # helm/templates/qwen-deployment.yaml:44-46).
+    import json as _json
+    from pathlib import Path as _Path
+
+    from githubrepostorag_tpu.models.hf_loader import config_from_hf
+
+    cfg = config_from_hf(
+        _json.loads((_Path(s.model_weights_path) / "config.json").read_text()),
         moe_capacity_factor=s.moe_capacity_factor,
     )
-
-    # TP-shard the decoder over the chip's ICI mesh (vLLM's
-    # --tensor-parallel-size equivalent; reference runs TP=1 on one GPU —
-    # helm/templates/qwen-deployment.yaml:44-46).  MESH_SHAPE overrides the
-    # automatic plan (e.g. "tp:4,sp:2" to also enable sequence-parallel
-    # long-prompt prefill).
-    n = len(jax.devices())
     if s.mesh_shape:
         from githubrepostorag_tpu.parallel import plan_from_string
 
@@ -80,6 +86,12 @@ async def serve(host: str, port: int) -> None:
             n, num_heads=cfg.num_heads, num_kv_heads=cfg.num_kv_heads, role="serve"
         )
         plan = MeshPlan(tp=plan.tp)
+
+    params, cfg = load_qwen2(
+        s.model_weights_path, dtype=ml_dtypes.bfloat16, quantize=s.quantize_weights,
+        moe_capacity_factor=s.moe_capacity_factor,
+        fuse=plan.n_devices == 1,  # mesh=None below iff the plan is one chip
+    )
 
     # tokenizer first: a broken tokenizer config must fail fast, not after
     # minutes of XLA warmup compiles

@@ -326,3 +326,48 @@ def test_w4a8_3d_batch_f32_out_and_zero_rows():
     assert got.shape == (2, 3, OUT) and got.dtype == jnp.float32
     assert np.isfinite(np.asarray(got)).all()
     np.testing.assert_allclose(np.asarray(got), oracle, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_projections_match_unfused():
+    """quant.fuse_projections (the single-chip serving layout) must be a
+    pure re-layout: forward logits match the per-projection tree for both
+    bf16 and quantized leaves, and the engine auto-fuses mesh-less trees."""
+    from githubrepostorag_tpu.models.quant import fuse_projections
+    from githubrepostorag_tpu.models.qwen2 import forward as qwen_forward
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    rng = np.random.default_rng(13)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 9)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(9)[None], (2, 9))
+    ref, _ = qwen_forward(params, cfg, ids, pos)
+    import copy
+
+    fused = fuse_projections(copy.copy({**params, "layers": dict(params["layers"])}))
+    assert "wqkv" in fused["layers"] and "wq" not in fused["layers"]
+    got, _ = qwen_forward(fused, cfg, ids, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # idempotent (a second Engine wrapping the same tree must not re-concat)
+    again = fuse_projections(fused)
+    assert again["layers"]["wqkv"] is fused["layers"]["wqkv"]
+    assert set(again["layers"]) == set(fused["layers"])
+
+    qparams = quantize_qwen2_params(
+        init_params(cfg, jax.random.PRNGKey(4), dtype=jnp.float32), bits=4,
+        group_size=G,
+    )
+    refq, _ = qwen_forward(qparams, cfg, ids, pos)
+    fusedq = fuse_projections({**qparams, "layers": dict(qparams["layers"])})
+    gotq, _ = qwen_forward(fusedq, cfg, ids, pos)
+    np.testing.assert_allclose(np.asarray(gotq), np.asarray(refq), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_init_params_quantized_fused_geometry():
+    cfg = Qwen2Config.tiny()
+    p = init_params_quantized(cfg, bits=4, group_size=G, fuse=True)
+    L, d = cfg.num_layers, cfg.hidden_size
+    qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+    assert p["layers"]["wqkv"].q.shape == (L, d // 2, qkv_out)
+    assert p["layers"]["wgu"].q.shape == (L, d // 2, 2 * cfg.intermediate_size)
+    assert "wq" not in p["layers"] and "wg" not in p["layers"]
