@@ -293,7 +293,7 @@ def bench_prefix_cache(cfg, *, engine, prefix_len: int, tag: str,
     return cold, warm
 
 
-def bench_spec_decode(params_in, cfg) -> tuple[float, float, float, float]:
+def bench_spec_decode(params_in, cfg) -> tuple[float, float, float, float, float]:
     """Speculative n-gram decoding in its acceptance regime (VERDICT r02
     weak #4: random weights give ~0 natural acceptance, so no spec number
     existed).  Construction: zero out every LAYER weight — the residual
@@ -340,14 +340,30 @@ def bench_spec_decode(params_in, cfg) -> tuple[float, float, float, float]:
         eng.generate([_prompts(1, 64, cfg.vocab_size, seed=12)[0]], sp)
         return time.monotonic() - t0
 
+    def run_spec_burst():
+        # the fused form (serving/spec_burst.py): draft+verify on device,
+        # ~gen/(k+1) verify forwards per generation instead of gen forwards
+        # — and no per-verify host round trip (which is what the plain
+        # spec-vs-burst ratio above actually measures through a tunnel)
+        eng = Engine(params, cfg, max_num_seqs=1, num_pages=16, page_size=64,
+                     max_seq_len=512, prefill_chunk=64, use_pallas=use_pallas,
+                     spec_ngram_k=8, spec_burst_iters=16)
+        eng.generate([prompt], sp)
+        t0 = time.monotonic()
+        eng.generate([_prompts(1, 64, cfg.vocab_size, seed=12)[0]], sp)
+        return time.monotonic() - t0, eng.spec_proposed, eng.spec_accepted
+
     spec_wall, dispatches, proposed, accepted = run_spec()
     burst_wall = run_burst()
+    sburst_wall, sb_prop, sb_acc = run_spec_burst()
     toks_per_dispatch = gen / max(dispatches, 1)
     acceptance = accepted / max(proposed, 1)
     log(f"bench[spec]: {gen} toks in {dispatches} dispatches "
         f"({toks_per_dispatch:.2f} tok/dispatch), acceptance {acceptance:.2f}, "
-        f"spec {spec_wall:.2f}s vs burst {burst_wall:.2f}s at bs=1")
-    return toks_per_dispatch, acceptance, spec_wall, burst_wall
+        f"spec {spec_wall:.2f}s vs burst {burst_wall:.2f}s vs FUSED spec "
+        f"burst {sburst_wall:.2f}s at bs=1 (fused acceptance "
+        f"{sb_acc / max(sb_prop, 1):.2f})")
+    return toks_per_dispatch, acceptance, spec_wall, burst_wall, sburst_wall
 
 
 def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
@@ -472,9 +488,14 @@ def _main() -> None:
         # continuous batching, qwen-deployment.yaml:32-33) — params are
         # already resident, so this costs only the engine compile + run
         if budget_allows("concurrent64-7b-int8", 300):
+            # decode_burst=8 (not 32): at 7B a 64-row burst iteration is
+            # ~35 ms, so a 32-step burst blocks prompt admission for >1 s
+            # and p50 TTFT measured 1.85 s; short bursts admit a prefill
+            # chunk every ~0.3 s (r04) — TTFT is this item's target,
+            # throughput is the bs=32 item's
             eng7c = Engine(params7, cfg7, max_num_seqs=64, num_pages=320,
-                           page_size=64, max_seq_len=1024, prefill_chunk=256,
-                           use_pallas=True, decode_burst=32)
+                           page_size=64, max_seq_len=1024, prefill_chunk=512,
+                           use_pallas=True, decode_burst=8)
             log("bench[64seq-7b-int8]: warmup (compiles all row buckets)")
             eng7c.warmup()
             agg7, p507 = bench_concurrency(cfg7, streams=64, prompt_len=128,
@@ -487,6 +508,30 @@ def _main() -> None:
                  BASELINE_TTFT_S / max(p507, 1e-9))
             del eng7c
         del params7
+        gc.collect()
+
+    # ---- eval config #2 latency regime, SERVED int8 (1.5B, bs=8) ---------
+    # the reference deploys 4-bit AWQ for its serving model
+    # (/root/reference/helm/values.yaml:67); int8 weight-only is this
+    # repo's same call for the latency regime — bf16 bs8 sits at ~70% of
+    # roofline with weight reads the floor, so halving the weight bytes is
+    # the honest lever (the bf16 number stays below for continuity)
+    if budget_allows("qwen2-1.5b-int8", 240):
+        from githubrepostorag_tpu.models.quant import init_params_quantized
+
+        cfg15q = Qwen2Config.qwen2_1_5b()
+        log("bench[qwen2-1.5b-int8]: building host-side int8 params")
+        params15q = init_params_quantized(cfg15q, bits=8, fuse=True)
+        jax.block_until_ready(params15q)
+        tps15q, _, _ = bench_decode(cfg15q, "qwen2-1.5b-int8", batch=8,
+                                    prompt_len=128, gen_tokens=256,
+                                    num_pages=64, page_size=256,
+                                    max_seq=1024, runs=2, params=params15q,
+                                    decode_burst=128)
+        emit("decode_tok_s_per_chip_qwen2-1.5b_int8_bs8", tps15q, "tok/s",
+             tps15q / BASELINE_TOK_S,
+             **decode_extras(tps15q, 8, streamed_nbytes(params15q)))
+        del params15q
         gc.collect()
 
     # ---- eval config #2 geometry (1.5B, bs=8 and bs=32) ------------------
@@ -550,10 +595,13 @@ def _main() -> None:
     # accepted tokens measured 0.48x of 16-step fused bursts; with a bigger
     # forward the verify dispatch amortizes and spec should cross 1.0)
     if params15 is not None and budget_allows("spec-decode-1.5b", 150):
-        tpd15, acc15, spec_w15, burst_w15 = bench_spec_decode(params15, cfg15)
+        (tpd15, acc15, spec_w15, burst_w15,
+         sburst_w15) = bench_spec_decode(params15, cfg15)
         emit("spec_decode_tok_per_dispatch_qwen2-1.5b", tpd15, "tok/dispatch", None)
         emit("spec_decode_speedup_vs_burst_bs1_qwen2-1.5b",
              burst_w15 / max(spec_w15, 1e-9), "x", None)
+        emit("spec_burst_speedup_vs_burst_bs1_qwen2-1.5b",
+             burst_w15 / max(sburst_w15, 1e-9), "x", None)
     del params15
     gc.collect()
 
@@ -625,11 +673,14 @@ def _main() -> None:
 
     # ---- speculative decoding in its acceptance regime -------------------
     if budget_allows("spec-decode", 150):
-        tpd, acc, spec_wall, burst_wall = bench_spec_decode(params05_or_init(), cfg05)
+        (tpd, acc, spec_wall, burst_wall,
+         sburst_wall) = bench_spec_decode(params05_or_init(), cfg05)
         emit("spec_decode_tok_per_dispatch_qwen2-0.5b", tpd, "tok/dispatch", None)
         emit("spec_decode_acceptance_qwen2-0.5b", acc, "ratio", None)
         emit("spec_decode_speedup_vs_burst_bs1", burst_wall / max(spec_wall, 1e-9),
              "x", None)
+        emit("spec_burst_speedup_vs_burst_bs1_qwen2-0.5b",
+             burst_wall / max(sburst_wall, 1e-9), "x", None)
 
     # ---- ingest embedding chunks/sec -------------------------------------
     if budget_allows("embed", 60):

@@ -154,6 +154,13 @@ class Settings:
     # knob for quoting-heavy greedy decodes, 0 (bursts) is the throughput
     # default
     spec_ngram_k: int = field(default_factory=lambda: _env_int("SPEC_NGRAM_K", 0))
+    # >0 with SPEC_NGRAM_K: fuse this many draft/verify iterations into one
+    # device program for all-greedy batches (serving/spec_burst.py) — the
+    # host-dispatched spec path pays a round trip per verify and measured
+    # 0.5x of fused bursts (BENCH r03/r04)
+    spec_burst_iters: int = field(
+        default_factory=lambda: _env_int("SPEC_BURST_ITERS", 0)
+    )
     # int8 KV cache pages with per-token dequant scales: halves KV reads
     # and doubles effective page capacity (serving/kv_cache.py quantize_kv)
     kv_quant: bool = field(default_factory=lambda: _env_bool("KV_QUANT", False))

@@ -112,6 +112,7 @@ async def serve(host: str, port: int) -> None:
             prefix_caching=s.prefix_caching,
             sp_prefill_threshold=s.sp_prefill_threshold or None,
             spec_ngram_k=s.spec_ngram_k,
+            spec_burst_iters=s.spec_burst_iters,
         )
 
     if plan.dp > 1:

@@ -112,6 +112,11 @@ class Engine:
         # sequence-parallel over the mesh's sp axis (serving/long_prefill.py)
         spec_ngram_k: int = 0,  # >0: n-gram speculative decoding with drafts
         # of up to k tokens (serving/spec_decode.py) instead of decode bursts
+        spec_burst_iters: int = 0,  # >0 (with spec_ngram_k>0): fuse this many
+        # draft->verify->accept iterations into ONE device program
+        # (serving/spec_burst.py) whenever every running row is plain
+        # greedy — removes the per-verify dispatch round trip that made
+        # host-dispatched spec decode a measured loss (BENCH r03/r04)
     ) -> None:
         self.mesh = mesh
         if mesh is not None:
@@ -186,6 +191,15 @@ class Engine:
         self._sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         self.sp_prefills = 0  # stats: prompts served by the ring-prefill path
         self.spec_ngram_k = spec_ngram_k
+        if spec_burst_iters > 0 and spec_ngram_k <= 0:
+            # fail fast on the inert combo (same policy as kv_quant+sp):
+            # the fused burst only engages inside the spec_ngram_k gate
+            raise ValueError(
+                "spec_burst_iters requires spec_ngram_k > 0 "
+                "(SPEC_BURST_ITERS fuses the n-gram spec path; without "
+                "SPEC_NGRAM_K it would silently do nothing)"
+            )
+        self.spec_burst_iters = spec_burst_iters
         self.spec_proposed = 0  # stats: draft tokens offered / accepted
         self.spec_accepted = 0
         self.requests_admitted = 0  # cumulative add_request count
@@ -308,9 +322,18 @@ class Engine:
         self._reap_cancelled(finished)
 
         self._try_prefill(finished)
-        if any(r.state == "running" for r in self._row_req.values()):
+        running = [r for r in self._row_req.values() if r.state == "running"]
+        if running:
             if self.spec_ngram_k > 0:
-                self._spec_decode_step(finished)
+                all_greedy = all(
+                    r.sampling.temperature <= 0.0
+                    and r.sampling.repetition_penalty == 1.0
+                    for r in running
+                )
+                if self.spec_burst_iters > 0 and all_greedy:
+                    self._spec_burst_step(finished)
+                else:
+                    self._spec_decode_step(finished)
             else:
                 self._decode_step(finished)
         if not self._row_req:
@@ -717,6 +740,68 @@ class Engine:
         }
         if prev is not None:
             self._commit_burst(prev, finished)
+
+    def _spec_burst_step(self, finished: list[GenerationResult]) -> None:
+        """``spec_burst_iters`` fused draft/verify/accept iterations in ONE
+        dispatch (serving/spec_burst.py) — the on-device form of
+        _spec_decode_step for all-plain-greedy batches.  One [B, iters,
+        k+1] token fetch per burst; stop/length bookkeeping happens here
+        on the packed tokens, like _commit_burst."""
+        from githubrepostorag_tpu.serving.spec_burst import spec_decode_burst
+
+        k = self.spec_ngram_k
+        running = [r for r in self._row_req.values() if r.state == "running"]
+        rb = _bucket(len(running), self.max_num_seqs, minimum=1)
+        h = self.max_seq_len
+        hist = np.zeros((rb, h), dtype=np.int32)
+        hlens = np.zeros((rb,), dtype=np.int32)
+        lens = np.zeros((rb,), dtype=np.int32)
+        bt = np.zeros((rb, self.max_pages_per_seq), dtype=np.int32)
+        limits = np.zeros((rb,), dtype=np.int32)
+        active = np.zeros((rb,), dtype=bool)
+        for i, req in enumerate(running):
+            toks = (req.prompt + req.output)[-h:]
+            hist[i, : len(toks)] = toks
+            hlens[i] = len(toks)
+            lens[i] = req.seq_len
+            bt[i] = self._block_tables[req.row]
+            limits[i] = self._row_limits[req.row]
+            active[i] = True
+
+        with annotate("engine.spec_burst"):
+            out = spec_decode_burst(
+                self.params, self.cfg,
+                jnp.asarray(hist), jnp.asarray(hlens), jnp.asarray(lens),
+                self._k_pages, self._v_pages,
+                jnp.asarray(bt), jnp.asarray(limits), jnp.asarray(active),
+                n_iters=self.spec_burst_iters, k=k,
+                use_pallas=self.use_pallas, int4_kernel=self._int4_kernel,
+                k_scales=self._k_scales, v_scales=self._v_scales,
+            )
+        if self.kv_quant:
+            (toks_d, prop_d, self._k_pages, self._v_pages,
+             self._k_scales, self._v_scales) = out
+        else:
+            toks_d, prop_d, self._k_pages, self._v_pages = out
+        toks = np.asarray(toks_d)  # [rb, iters, k+1], -1 padded
+        prop = np.asarray(prop_d)  # [rb, iters]
+        for i, req in enumerate(running):
+            for it in range(toks.shape[1]):
+                if req.state != "running":
+                    break  # the device kept drafting past this row's stop;
+                    # those iterations' tokens AND proposals are discarded
+                self.spec_proposed += int(prop[i, it])
+                committed = 0
+                for t in toks[i, it]:
+                    if t < 0 or req.state != "running":
+                        break
+                    req.seq_len += 1
+                    self._seq_lens[req.row] = req.seq_len
+                    self._commit_token(req, int(t), finished)
+                    committed += 1
+                if committed:
+                    # committed = agreed draft prefix + 1 correction token
+                    self.spec_accepted += committed - 1
 
     def _spec_decode_step(self, finished: list[GenerationResult]) -> None:
         """One speculative iteration (serving/spec_decode.py): rows on plain

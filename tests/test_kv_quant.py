@@ -92,12 +92,16 @@ def test_kv_quant_composes_with_prefix_cache(tiny):
     assert warm == cold
 
 
-def test_kv_quant_spec_decode_runs(tiny):
-    """Spec mode verifies drafts through forward_paged's quantized path."""
+@pytest.mark.parametrize("burst_iters", [0, 3])
+def test_kv_quant_spec_decode_runs(tiny, burst_iters):
+    """Spec mode verifies drafts through forward_paged's quantized path —
+    both host-dispatched (burst_iters=0) and the fused on-device burst
+    (its quant branch threads the scale pools through the scan carry)."""
     cfg, params = tiny
     zero_layers = jax.tree.map(jnp.zeros_like, params["layers"])
     rep_params = dict(params, layers=zero_layers)  # repeater: drafts accept
-    eng = _engine(rep_params, cfg, kv_quant=True, spec_ngram_k=4)
+    eng = _engine(rep_params, cfg, kv_quant=True, spec_ngram_k=4,
+                  spec_burst_iters=burst_iters)
     sp = SamplingParams(max_tokens=16, temperature=0.0, stop_token_ids=())
     res = eng.generate([[5, 6, 7, 8]], sp)[0]
     assert len(res.output_tokens) == 16

@@ -181,3 +181,94 @@ def test_spec_with_prefix_cache_and_continuous_batching(tiny):
     assert done[r1].output_tokens == exp1
     assert done[r2].output_tokens == exp2
     assert eng._allocator.hit_tokens > 0  # p2 resumed from p1's pages
+
+
+# ----------------------------------------------------- fused spec bursts --
+
+
+def test_ngram_draft_device_matches_expectations():
+    import jax.numpy as jnp
+
+    from githubrepostorag_tpu.serving.spec_burst import ngram_draft_device
+
+    hist = np.zeros((3, 16), dtype=np.int32)
+    # row 0: bigram [1,2] recurs — earliest at 0, followers [3, 9]
+    hist[0, :7] = [1, 2, 3, 9, 9, 1, 2]
+    # row 1: no repeat
+    hist[1, :5] = [5, 6, 7, 8, 9]
+    # row 2: too short for a match (needs >= 4 tokens)
+    hist[2, :3] = [4, 4, 4]
+    draft, dlen = ngram_draft_device(jnp.asarray(hist),
+                                     jnp.asarray([7, 5, 3], dtype=jnp.int32), 4)
+    draft, dlen = np.asarray(draft), np.asarray(dlen)
+    assert dlen.tolist() == [4, 0, 0]
+    assert draft[0, :4].tolist() == [3, 9, 9, 1]
+
+
+def test_spec_burst_token_identical_and_accepts(tiny):
+    """The fused on-device spec burst must produce byte-identical greedy
+    output to both the plain burst engine and the host-dispatched spec
+    path, while actually accepting drafts on a looping sequence."""
+    model, params, cfg = tiny
+    prompt = [7, 8, 9, 10] * 8
+    sp = SamplingParams(max_tokens=32, temperature=0.0, stop_token_ids=(),
+                        repetition_penalty=1.0)
+    plain = _engine(params, cfg).generate([prompt], sp)[0].output_tokens
+
+    eng = _engine(params, cfg, spec_ngram_k=4, spec_burst_iters=4)
+    got = eng.generate([prompt], sp)[0].output_tokens
+    assert got == plain
+    assert eng.spec_proposed > 0
+    assert eng.spec_accepted > 0
+
+    with torch.no_grad():
+        hf = model.generate(torch.tensor([prompt]), max_new_tokens=32,
+                            do_sample=False, pad_token_id=0, eos_token_id=None,
+                            use_cache=True)
+    assert got == hf[0, len(prompt):].tolist()
+
+
+def test_spec_burst_batch_and_stop(tiny):
+    """Multi-row fused spec bursts: random prompts (no drafts -> 1
+    token/iteration) and looping prompts in one batch, stop tokens and
+    max_tokens respected mid-burst."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(9)
+    prompts = [
+        [3, 4, 5] * 10,
+        rng.integers(0, cfg.vocab_size, 21).tolist(),
+    ]
+    sp = SamplingParams(max_tokens=12, temperature=0.0, stop_token_ids=())
+    plain = _engine(params, cfg)
+    res_p = plain.generate(prompts, [sp, sp])
+    spec = _engine(params, cfg, spec_ngram_k=4, spec_burst_iters=3)
+    res_s = spec.generate(prompts, [sp, sp])
+    for a, b in zip(res_s, res_p):
+        assert a.output_tokens == b.output_tokens
+        assert a.finish_reason == "length"
+
+    # stop token: generation ends exactly where the plain engine ends
+    tok_stop = res_p[0].output_tokens[4]
+    sp_stop = SamplingParams(max_tokens=12, temperature=0.0,
+                             stop_token_ids=(tok_stop,))
+    stop_p = _engine(params, cfg).generate([prompts[0]], sp_stop)[0]
+    stop_s = _engine(params, cfg, spec_ngram_k=4,
+                     spec_burst_iters=3).generate([prompts[0]], sp_stop)[0]
+    assert stop_s.output_tokens == stop_p.output_tokens
+    assert stop_s.finish_reason == stop_p.finish_reason == "stop"
+
+
+def test_spec_burst_falls_back_for_sampled_rows(tiny):
+    """A sampled row in the batch drops the engine to the host spec path —
+    outputs still match the plain engine for the deterministic row."""
+    _, params, cfg = tiny
+    prompts = [[5, 6, 7] * 8, [9, 1, 2] * 7]
+    sps = [
+        SamplingParams(max_tokens=10, temperature=0.0, stop_token_ids=()),
+        SamplingParams(max_tokens=10, temperature=0.9, stop_token_ids=()),
+    ]
+    plain = _engine(params, cfg, rng_seed=11)
+    spec = _engine(params, cfg, rng_seed=11, spec_ngram_k=4, spec_burst_iters=4)
+    res_p = plain.generate(prompts, sps)
+    res_s = spec.generate(prompts, sps)
+    assert res_s[0].output_tokens == res_p[0].output_tokens
