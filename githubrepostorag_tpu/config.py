@@ -162,7 +162,8 @@ class Settings:
         default_factory=lambda: _env_int("SPEC_BURST_ITERS", 0)
     )
     # int8 KV cache pages with per-token dequant scales: halves KV reads
-    # and doubles effective page capacity (serving/kv_cache.py quantize_kv)
+    # and doubles effective page capacity (kv_cache.quantize_kv_paged:
+    # per-page scales riding the decode kernel's scalar-prefetch channel)
     kv_quant: bool = field(default_factory=lambda: _env_bool("KV_QUANT", False))
     # MoE serving expert capacity = ceil(K*T/E * factor); overflow
     # assignments drop that expert's contribution (models/moe.py; set

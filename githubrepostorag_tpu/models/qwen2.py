@@ -394,7 +394,7 @@ def forward_paged(
     new_lens: jnp.ndarray,  # [B] valid new tokens this step
     use_pallas: bool = False,
     logits_at: jnp.ndarray | None = None,  # [B] per-row position, see below
-    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P, page_size] f32 —
+    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P] f32 (per-page) —
     v_scales: jnp.ndarray | None = None,  # int8 (kv_quant) pool scales
     int4_kernel: bool = True,  # False under TP-sharded int4 weights
     # (pallas_call has no GSPMD partitioning rule — see quant.Layered4XLA)
@@ -408,7 +408,8 @@ def forward_paged(
     enough that their copy is noise).
 
     ``k_scales``/``v_scales`` mark int8 kv_quant pools: new K/V quantize
-    per token vector at the scatter (kv_cache.quantize_kv) and attention
+    per PAGE at the scatter (kv_cache.quantize_kv_paged: the first write
+    to a page fixes its scale, appends reuse it and clip) and attention
     runs the gather path with dequant — prefill/verify chunks are
     compute-dominated, so the materialized gather costs little here; the
     decode hot path (decode_burst) reads int8 pages directly in its
@@ -495,16 +496,14 @@ def forward_paged_impl(
             k_t = k.reshape(-1, nkv, hd).swapaxes(0, 1)  # [n_kv, B*S, hd]
             v_t = v.reshape(-1, nkv, hd).swapaxes(0, 1)
             if quant:
-                from githubrepostorag_tpu.serving.kv_cache import quantize_kv
+                from githubrepostorag_tpu.serving.kv_cache import (
+                    quantize_kv_paged,
+                )
 
-                k_t, k_s = quantize_kv(k_t)
-                v_t, v_s = quantize_kv(v_t)
-                ks_flat = ks.reshape(nkv, total_slots)
-                vs_flat = vs.reshape(nkv, total_slots)
-                ks_flat = ks_flat.at[:, flat_slots].set(k_s, mode="drop")
-                vs_flat = vs_flat.at[:, flat_slots].set(v_s, mode="drop")
-                new_ks = ks_flat.reshape(nkv, num_pages, page_size)
-                new_vs = vs_flat.reshape(nkv, num_pages, page_size)
+                # per-page scales [n_kv, P]: first write fixes a page's
+                # scale, appends reuse it (kv_cache.quantize_kv_paged)
+                k_t, new_ks = quantize_kv_paged(k_t, flat_slots, ks, page_size)
+                v_t, new_vs = quantize_kv_paged(v_t, flat_slots, vs, page_size)
             else:
                 k_t = k_t.astype(kp.dtype)
                 v_t = v_t.astype(vp.dtype)

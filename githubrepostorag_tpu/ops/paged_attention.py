@@ -31,9 +31,9 @@ def gather_kv(k_pages, v_pages, block_tables, k_scales=None, v_scales=None,
               dtype=None):
     """[n_kv, P, ps, hd] + [B, max_pages] -> [B, max_pages*ps, n_kv, hd].
 
-    With ``k_scales``/``v_scales`` ([n_kv, P, ps] per-token dequant scales,
-    kv_quant pools) the gathered int8 pages dequantize to ``dtype``
-    (default bf16) on the way out."""
+    With ``k_scales``/``v_scales`` ([n_kv, P] per-PAGE dequant scales,
+    kv_quant pools — kv_cache.quantize_kv_paged) the gathered int8 pages
+    dequantize to ``dtype`` (default bf16) on the way out."""
     b, max_pages = block_tables.shape
     n_kv, _, ps, hd = k_pages.shape
 
@@ -43,8 +43,8 @@ def gather_kv(k_pages, v_pages, block_tables, k_scales=None, v_scales=None,
         g = g.reshape(b, max_pages * ps, n_kv, hd)
         if scales is None:
             return g
-        s = jnp.moveaxis(scales[:, block_tables], 0, 3)  # [B, mp, ps, n_kv]
-        s = s.reshape(b, max_pages * ps, n_kv)
+        s = jnp.moveaxis(scales[:, block_tables], 0, 2)  # [B, mp, n_kv]
+        s = jnp.repeat(s, ps, axis=1)  # page scale -> its ps token rows
         return (g.astype(jnp.float32) * s[..., None]).astype(dtype or jnp.bfloat16)
 
     return gather(k_pages, k_scales), gather(v_pages, v_scales)

@@ -173,50 +173,53 @@ def _decode_staged_kernel(
     kernel's fixed per-step cost off the decode critical path.
 
     Refs, in order: scalar prefetch [block_tables (B, max_pages) SMEM,
-    pool_lens (B), staged_len (1), + layer (1) when ``layered``], blocks
+    pool_lens (B), staged_len (1), + layer (1) when ``layered``, + k/v
+    per-PAGE scales (n_kv, P) f32 when ``kv_quant``], blocks
     [q (1, n_kv, group, hd) VMEM, k/v (one pool page, every kv head —
     leading extra 1 for the layer axis when ``layered``), staged k/v
-    (1, n_kv, n_steps, hd), + k/v scale tiles when ``kv_quant``], out
-    (1, n_kv, group, hd), scratch [m, l (n_kv, group, 128) f32, acc
-    (n_kv, group, hd) f32].  ``kv_quant``: pool tiles are int8 with
-    per-token scales arriving as [.., page_size, 1] blocks — the trailing
-    singleton keeps the block minor dims Mosaic-tileable — riding the
-    same page index map; dequant happens here in VMEM, right before the
-    dots."""
-    n_scalars = 4 if layered else 3
-    n_blocks = 7 if kv_quant else 5
+    (1, n_kv, n_steps, hd)], out (1, n_kv, group, hd), scratch [m, l
+    (n_kv, group, 128) f32, acc (n_kv, group, hd) f32].  ``kv_quant``:
+    pool tiles are int8; each page's scale is read per kv head from the
+    SMEM scalar channel (zero extra operand DMAs — per-token scale tiles
+    measured 5-18x slower, r04) and dequant happens here in VMEM, right
+    before the dots."""
+    n_scalars = (4 if layered else 3) + (2 if kv_quant else 0)
     scalar_refs = refs[:n_scalars]
     block_tables_ref, pool_lens_ref, staged_len_ref = scalar_refs[:3]
-    blocks = refs[n_scalars : n_scalars + n_blocks]
-    q_ref, k_ref, v_ref, sk_ref, sv_ref = blocks[:5]
-    out_ref, m_ref, l_ref, acc_ref = refs[n_scalars + n_blocks :]
+    blocks = refs[n_scalars : n_scalars + 5]
+    q_ref, k_ref, v_ref, sk_ref, sv_ref = blocks
+    out_ref, m_ref, l_ref, acc_ref = refs[n_scalars + 5 :]
     if layered:
         raw_k = lambda: k_ref[0, :, 0]  # [n_kv, page_size, hd]
         raw_v = lambda: v_ref[0, :, 0]
     else:
         raw_k = lambda: k_ref[:, 0]
         raw_v = lambda: v_ref[:, 0]
-    if kv_quant:
-        # scale operands carry a trailing singleton so their BLOCK minor
-        # dims are (page_size, 1) — a (.., 1, page_size) block would put
-        # the one-page axis second-minor, which Mosaic rejects (not
-        # 8-aligned, not the full page axis)
-        ks_ref, vs_ref = blocks[5:]
-        if layered:
-            page_scale = lambda ref: ref[0, :, 0, :, 0]  # [n_kv, page_size]
-        else:
-            page_scale = lambda ref: ref[:, 0, :, 0]
-        k_page = lambda: (
-            raw_k().astype(jnp.float32) * page_scale(ks_ref)[..., None]
-        )
-        v_page = lambda: (
-            raw_v().astype(jnp.float32) * page_scale(vs_ref)[..., None]
-        )
-    else:
-        k_page, v_page = raw_k, raw_v
     bi = pl.program_id(0)
     pi = pl.program_id(1)
     num_pi = pl.num_programs(1)
+    if kv_quant:
+        # per-PAGE scales ride the SCALAR-PREFETCH channel ([n_kv, P] f32
+        # in SMEM, already layer-sliced by the wrapper) and are read as
+        # per-head scalars — the r03 per-token scale TILES added two tiny
+        # operand DMAs to every (row, page) grid step and measured 5-18x
+        # slower than bf16 pools; int8 pages with SMEM scales run at bf16
+        # speed + halved KV HBM (r04 isolation)
+        ks_ref, vs_ref = scalar_refs[-2:]
+        n_kv_heads = k_ref.shape[1] if layered else k_ref.shape[0]
+        page = block_tables_ref[bi, jnp.minimum(pi, num_pi - 2)]
+
+        def dequant(raw, ref):
+            # per-head scalar-from-SMEM x [ps, hd] plane, restacked on the
+            # leading axis (a [n_kv] vector reshaped to [n_kv,1,1] is an
+            # unsupported Mosaic shape cast; scalar broadcasts are free)
+            x = raw().astype(jnp.float32)
+            return jnp.stack([x[h] * ref[h, page] for h in range(n_kv_heads)])
+
+        k_page = lambda: dequant(raw_k, ks_ref)
+        v_page = lambda: dequant(raw_v, vs_ref)
+    else:
+        k_page, v_page = raw_k, raw_v
 
     @pl.when(pi == 0)
     def _():
@@ -282,8 +285,8 @@ def paged_attention_decode_staged(
     staged_v: jnp.ndarray,
     staged_len: jnp.ndarray,  # [1] int32 — staged entries valid this step
     layer: jnp.ndarray | None = None,  # [] / [1] int32, REQUIRED for rank-5
-    k_scales: jnp.ndarray | None = None,  # pool dequant scales (int8 pools):
-    v_scales: jnp.ndarray | None = None,  # [(L,) n_kv, P, ps] f32
+    k_scales: jnp.ndarray | None = None,  # per-PAGE dequant scales (int8
+    v_scales: jnp.ndarray | None = None,  # pools): [(L,) n_kv, P] f32
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Burst-decode attention over [pool prefix | staged tail] without ever
@@ -298,10 +301,11 @@ def paged_attention_decode_staged(
     profiling showed the sliced form costing ~0.5 ms/step at 0.5B/bs8
     (2 x 4 MB x 24 layers of dynamic-slice copy traffic per decode step).
 
-    ``k_scales``/``v_scales`` mark int8 (kv_quant) pools: each page tile
-    arrives int8 with a per-token scale tile riding the same index map,
-    and dequant happens in VMEM right before the dots — KV HBM reads
-    halve; the staged tail stays full precision."""
+    ``k_scales``/``v_scales`` mark int8 (kv_quant) pools: page tiles
+    arrive int8 and dequantize in VMEM right before the dots with their
+    per-PAGE scale read from the scalar-prefetch SMEM channel — KV HBM
+    reads halve at zero extra operand DMAs (per-token scale tiles
+    measured 5-18x slower, r04); the staged tail stays full precision."""
     b, s, n_q, hd = q.shape
     assert s == 1, "staged kernel is the decode path (S == 1)"
     layered = k_pages.ndim == 5
@@ -334,13 +338,11 @@ def paged_attention_decode_staged(
             return (rest[0][0], 0, clamp_page(bi, pi, bt, pool), 0, 0)
 
         kv_block = (1, n_kv, 1, page_size, hd)
-        scale_block = (1, n_kv, 1, page_size, 1)
     else:
         def kv_map(bi, pi, bt, pool, sl, *rest):
             return (0, clamp_page(bi, pi, bt, pool), 0, 0)
 
         kv_block = (n_kv, 1, page_size, hd)
-        scale_block = (n_kv, 1, page_size, 1)
 
     def staged_map(bi, pi, *refs):
         return (bi, 0, 0, 0)
@@ -353,6 +355,16 @@ def paged_attention_decode_staged(
     ]
     if layered:
         scalars.append(jnp.reshape(layer, (1,)).astype(jnp.int32))
+    if kv_quant:
+        # per-page scales [n_kv, P] join the SCALAR-PREFETCH channel (SMEM,
+        # like the block tables): zero extra per-grid-step operand DMAs.
+        # Layer-sliced here — a [n_kv, P] f32 slice is ~KBs, not a pool copy
+        ks, vs = k_scales, v_scales
+        if layered:
+            li = jnp.reshape(layer, ()).astype(jnp.int32)
+            ks = jax.lax.dynamic_index_in_dim(ks, li, 0, keepdims=False)
+            vs = jax.lax.dynamic_index_in_dim(vs, li, 0, keepdims=False)
+        scalars += [ks.astype(jnp.float32), vs.astype(jnp.float32)]
     in_specs = [
         pl.BlockSpec((1, n_kv, group, hd), q_map),
         pl.BlockSpec(kv_block, kv_map),
@@ -361,11 +373,6 @@ def paged_attention_decode_staged(
         pl.BlockSpec((1, n_kv, n_steps, hd), staged_map),
     ]
     operands = [q_r, k_pages, v_pages, staged_k, staged_v]
-    if kv_quant:
-        # scale tiles ride kv_map: same (layer, page) block per grid step
-        in_specs += [pl.BlockSpec(scale_block, kv_map)] * 2
-        # trailing singleton keeps the block minor dims (page_size, 1)
-        operands += [k_scales[..., None], v_scales[..., None]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(scalars),
         grid=grid,

@@ -57,7 +57,7 @@ def _staged_attend_tp(mesh, interpret, quant: bool = False):
     tp shard runs the kernel on its local heads (q [B,1,nq/tp,hd], pools
     [n_kv/tp,...]) with zero collectives — GSPMD handles the dense program
     around it and inserts the row-parallel psums after wo/wd.  ``quant``
-    adds the int8 pools' per-token scale operands (sharded with their
+    adds the int8 pools' per-page scale operands (sharded with their
     pages' kv-head axis)."""
     from jax.experimental.shard_map import shard_map
 
@@ -79,7 +79,7 @@ def _staged_attend_tp(mesh, interpret, quant: bool = False):
         P(None),                          # layer index replicated
     ]
     if quant:
-        in_specs += [P(None, "tp", None, None)] * 2  # [L, n_kv, P, ps] scales
+        in_specs += [P(None, "tp", None)] * 2  # [L, n_kv, P] page scales
 
     return shard_map(
         call,
@@ -114,8 +114,8 @@ def decode_burst(
     n_steps: int,
     use_pallas: bool = False,
     mesh=None,  # jax.sharding.Mesh with a tp axis -> TP-sharded attention
-    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P, ps] f32: int8
-    v_scales: jnp.ndarray | None = None,  # (kv_quant) pool dequant scales
+    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P] f32: int8 (kv_quant)
+    v_scales: jnp.ndarray | None = None,  # pools' per-PAGE dequant scales
 ):
     """Run ``n_steps`` decode iterations for every active row.
 
@@ -294,13 +294,13 @@ def decode_burst(
         if scales is None:
             flat = flat.at[:, :, flat_slots].set(vals, mode="drop")
             return flat.reshape(pools.shape), None
-        from githubrepostorag_tpu.serving.kv_cache import quantize_kv
+        from githubrepostorag_tpu.serving.kv_cache import quantize_kv_paged
 
-        q, s = quantize_kv(vals)
+        # per-page scales [L, n_kv, P]: first write to a page fixes its
+        # scale, appends reuse it (kv_cache.quantize_kv_paged)
+        q, scales = quantize_kv_paged(vals, flat_slots, scales, page_size)
         flat = flat.at[:, :, flat_slots].set(q, mode="drop")
-        s_flat = scales.reshape(L, n_kv, total_slots)
-        s_flat = s_flat.at[:, :, flat_slots].set(s, mode="drop")
-        return flat.reshape(pools.shape), s_flat.reshape(scales.shape)
+        return flat.reshape(pools.shape), scales
 
     k_pages, k_scales = commit(k_pages, staged_k, k_scales)
     v_pages, v_scales = commit(v_pages, staged_v, v_scales)

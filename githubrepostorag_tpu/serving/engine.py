@@ -101,8 +101,10 @@ class Engine:
         max_seq_len: int = 2048,
         prefill_chunk: int = 512,
         kv_dtype=jnp.bfloat16,
-        kv_quant: bool = False,  # int8 KV pages with per-token scales —
-        # halves cache reads and doubles page capacity (kv_cache.quantize_kv)
+        kv_quant: bool = False,  # int8 KV pages with per-page scales —
+        # halves cache reads and doubles page capacity
+        # (kv_cache.quantize_kv_paged; scales ride the decode kernel's
+        # scalar-prefetch channel, costing zero extra operand DMAs)
         use_pallas: bool = False,
         rng_seed: int = 0,
         decode_burst: int = 8,
@@ -179,7 +181,8 @@ class Engine:
             self._k_pages = jax.device_put(self._k_pages, kv_sharding)
             self._v_pages = jax.device_put(self._v_pages, kv_sharding)
             if kv_quant:
-                s_sharding = NamedSharding(mesh, PS(None, kv_tp, None, None))
+                # per-page scales [L, n_kv, P]: sharded with the kv-head axis
+                s_sharding = NamedSharding(mesh, PS(None, kv_tp, None))
                 self._k_scales = jax.device_put(self._k_scales, s_sharding)
                 self._v_scales = jax.device_put(self._v_scales, s_sharding)
             self._replicated = NamedSharding(mesh, PS())

@@ -31,14 +31,14 @@ class PagePools:
     flat [n_kv, P*page_size, hd] view with one slot vector shared by all
     heads.
 
-    ``ks``/``vs``: per-token dequant scales [L, n_kv, P, page_size] f32
+    ``ks``/``vs``: per-PAGE dequant scales [L, n_kv, P] f32
     when the pools are int8 (``kv_quant`` engines — each cached token
     vector is symmetric int8 with its own scale: no calibration, and the
     scale read is 1/hd of the payload); None for full-precision pools."""
 
     k: jnp.ndarray  # [L, n_kv, P, page_size, hd]
     v: jnp.ndarray
-    ks: jnp.ndarray | None = None  # [L, n_kv, P, page_size] f32
+    ks: jnp.ndarray | None = None  # [L, n_kv, P] f32 (per-page)
     vs: jnp.ndarray | None = None
 
     @property
@@ -56,26 +56,79 @@ def make_page_pools(
 ) -> PagePools:
     shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
     if quant:
+        # per-PAGE scales [L, n_kv, P] (quantize_kv_paged): small enough
+        # for the decode kernel's scalar-prefetch channel — per-token
+        # scale tiles cost 5-18x in per-grid-step DMAs (r04)
         return PagePools(
             k=jnp.zeros(shape, dtype=jnp.int8),
             v=jnp.zeros(shape, dtype=jnp.int8),
-            ks=jnp.zeros(shape[:-1], dtype=jnp.float32),
-            vs=jnp.zeros(shape[:-1], dtype=jnp.float32),
+            ks=jnp.zeros(shape[:-2], dtype=jnp.float32),
+            vs=jnp.zeros(shape[:-2], dtype=jnp.float32),
         )
     return PagePools(k=jnp.zeros(shape, dtype=dtype), v=jnp.zeros(shape, dtype=dtype))
 
 
 def quantize_kv(x: jnp.ndarray):
     """Per-token-vector symmetric int8: ``x`` [..., hd] ->
-    (q int8 [..., hd], scale f32 [...]).  Each cached vector carries its
-    own scale, so no calibration pass and no cross-token error coupling —
-    the scheme behind the kv_quant pools (int8 KV halves cache reads and
-    doubles page capacity; VERDICT r02 #5)."""
+    (q int8 [..., hd], scale f32 [...]).  Kept as the reference recipe for
+    tests; the POOLS use per-page scales (quantize_kv_paged) — device
+    profiling showed the per-token scale tiles' tiny per-grid-step DMAs
+    costing the staged kernel 5-18x, while int8 pages with no scale
+    operands ran at bf16 speed (r04)."""
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=-1)
     s = jnp.maximum(amax / 127.0, 1e-8)
     q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
     return q, s
+
+
+# headroom on a page's first-write scale: later tokens appended to the same
+# page reuse it, so the first chunk's amax gets margin before clipping
+KV_SCALE_HEADROOM = 1.25
+
+
+def quantize_kv_paged(
+    vals: jnp.ndarray,  # [..., N, hd] new K or V vectors (any leading dims)
+    flat_slots: jnp.ndarray,  # [N] int32 pool slots; >= P*ps means dropped
+    scales: jnp.ndarray,  # [..., P] f32 per-page scales (0 = never written)
+    page_size: int,
+):
+    """Per-PAGE symmetric int8 quantization for pool writes.
+
+    A page's scale is fixed by the FIRST write that touches it (detected
+    as this batch containing the page's slot 0 — sequential fills always
+    open a page at its first slot) from that write's amax with
+    KV_SCALE_HEADROOM margin; later appends to a partially-filled page
+    reuse the stored scale and clip at +-127.  Per-page (not per-token)
+    because scales must reach the decode kernel WITHOUT per-grid-step
+    operand tiles: [n_kv, P] rides the scalar-prefetch SMEM channel like
+    the block tables, costing zero extra DMAs (VERDICT r03 #4b).
+
+    Returns (q int8 [..., N, hd], new_scales [..., P])."""
+    p = scales.shape[-1]
+    lead = scales.shape[:-1]
+    total = p * page_size
+    page_of = jnp.where(
+        (flat_slots >= 0) & (flat_slots < total), flat_slots // page_size, p
+    )  # sentinel page p -> dropped by the scatters below
+    amax = jnp.max(jnp.abs(vals.astype(jnp.float32)), axis=-1)  # [..., N]
+    zeros_ext = jnp.zeros((*lead, p + 1), jnp.float32)
+    page_amax = zeros_ext.at[..., page_of].max(amax, mode="drop")
+    fresh = jnp.zeros((p + 1,), bool).at[
+        jnp.where(flat_slots % page_size == 0, page_of, p)
+    ].set(True, mode="drop")
+    scale_new = jnp.maximum(page_amax * (KV_SCALE_HEADROOM / 127.0), 1e-8)
+    scales_ext = jnp.concatenate(
+        [scales, jnp.ones((*lead, 1), jnp.float32)], axis=-1
+    )
+    upd = jnp.where(fresh, scale_new, scales_ext)
+    tok_scale = jnp.take_along_axis(
+        upd, jnp.broadcast_to(page_of, (*lead, page_of.shape[0])), axis=-1
+    )  # [..., N]
+    q = jnp.clip(
+        jnp.round(vals.astype(jnp.float32) / tok_scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, upd[..., :p]
 
 
 class OutOfPages(RuntimeError):

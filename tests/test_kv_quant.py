@@ -56,9 +56,9 @@ def test_quant_pools_shapes_and_bytes():
     full = make_page_pools(cfg, 16, 8)
     quant = make_page_pools(cfg, 16, 8, quant=True)
     assert quant.k.dtype == jnp.int8
-    assert quant.ks.shape == quant.k.shape[:-1] and quant.ks.dtype == jnp.float32
+    assert quant.ks.shape == quant.k.shape[:-2] and quant.ks.dtype == jnp.float32
     payload = quant.k.nbytes + quant.ks.nbytes
-    assert payload < 0.65 * full.k.nbytes  # int8 + 1/hd scales vs bf16
+    assert payload < 0.55 * full.k.nbytes  # int8 + per-page scales vs bf16
 
 
 def test_engine_kv_quant_tracks_full_precision(tiny):
@@ -128,8 +128,13 @@ def test_staged_kernel_int8_matches_dequant_reference(tiny):
     q = jnp.asarray(rng.normal(size=(B, 1, n_kv * group, hd)), dtype=jnp.float32)
     kf = rng.normal(size=(L, n_kv, P, ps, hd)).astype(np.float32)
     vf = rng.normal(size=(L, n_kv, P, ps, hd)).astype(np.float32)
-    kq, ks = quantize_kv(jnp.asarray(kf))
-    vq, vs = quantize_kv(jnp.asarray(vf))
+    def quant_per_page(x):  # [L, n_kv, P, ps, hd] -> int8 + [L, n_kv, P]
+        s = np.maximum(np.abs(x).max(axis=(-2, -1)) / 127.0, 1e-8)
+        q = np.clip(np.round(x / s[..., None, None]), -127, 127).astype(np.int8)
+        return jnp.asarray(q), jnp.asarray(s.astype(np.float32))
+
+    kq, ks = quant_per_page(kf)
+    vq, vs = quant_per_page(vf)
     bt = jnp.asarray(rng.permutation(P)[: B * 3].reshape(B, 3), dtype=jnp.int32)
     pool_lens = jnp.asarray([9, 5], dtype=jnp.int32)
     sk = jnp.asarray(rng.normal(size=(B, n_kv, n_steps, hd)), dtype=jnp.float32)
@@ -142,8 +147,8 @@ def test_staged_kernel_int8_matches_dequant_reference(tiny):
     )
 
     # reference: dequantize layer 1's pages, gather, dense attention
-    kd = np.asarray(kq, dtype=np.float32) * np.asarray(ks)[..., None]
-    vd = np.asarray(vq, dtype=np.float32) * np.asarray(vs)[..., None]
+    kd = np.asarray(kq, dtype=np.float32) * np.asarray(ks)[..., None, None]
+    vd = np.asarray(vq, dtype=np.float32) * np.asarray(vs)[..., None, None]
     outs = []
     for b in range(B):
         pages = np.asarray(bt)[b]
@@ -165,3 +170,58 @@ def test_staged_kernel_int8_matches_dequant_reference(tiny):
         outs.append(np.asarray(out)[0])
     ref = np.stack(outs)
     np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_quantize_kv_paged_first_write_then_append():
+    """Per-page semantics: a page's scale is fixed by the write containing
+    its slot 0 (with headroom); a later append to the same page reuses the
+    stored scale and clips rather than rescaling; dropped slots (sentinel)
+    touch nothing."""
+    from githubrepostorag_tpu.serving.kv_cache import (
+        KV_SCALE_HEADROOM,
+        quantize_kv_paged,
+    )
+
+    ps, p, hd = 4, 8, 16
+    rng = np.random.default_rng(2)
+    scales = jnp.zeros((2, p), jnp.float32)  # [n_kv, P], never written
+
+    # first write: page 3 slots 12..13 (opens at slot 0 of page 3)
+    vals1 = jnp.asarray(rng.normal(0, 1.0, (2, 2, hd)), jnp.float32)
+    slots1 = jnp.asarray([12, 13], jnp.int32)
+    q1, scales = quantize_kv_paged(vals1, slots1, scales, ps)
+    s3 = np.asarray(scales)[:, 3]
+    expect = np.abs(np.asarray(vals1)).max(axis=(1, 2)) * KV_SCALE_HEADROOM / 127
+    np.testing.assert_allclose(s3, expect, rtol=1e-5)
+    assert (np.asarray(scales)[:, :3] == 0).all()
+
+    # append slots 14..15: same page, larger values -> clip, scale UNCHANGED
+    vals2 = jnp.asarray(rng.normal(0, 10.0, (2, 2, hd)), jnp.float32)
+    slots2 = jnp.asarray([14, 15], jnp.int32)
+    q2, scales2 = quantize_kv_paged(vals2, slots2, scales, ps)
+    np.testing.assert_allclose(np.asarray(scales2)[:, 3], s3, rtol=0)
+    assert np.abs(np.asarray(q2)).max() == 127  # clipped, not rescaled
+
+    # dropped sentinel slots leave scales untouched
+    q3, scales3 = quantize_kv_paged(vals1, jnp.asarray([-1, p * ps], jnp.int32),
+                                    scales2, ps)
+    np.testing.assert_array_equal(np.asarray(scales3), np.asarray(scales2))
+
+    # roundtrip error within a freshly-scaled page is bounded by scale/2
+    back = np.asarray(q1, np.float32) * s3[:, None, None]
+    err = np.abs(back - np.asarray(vals1))
+    assert (err <= s3[:, None, None] / 2 + 1e-6).all()
+
+
+def test_kv_quant_engine_with_tp_mesh(tiny):
+    """kv_quant composes with a TP mesh: rank-3 scale pools shard with
+    their kv-head axis (regression: the device_put spec kept 4 axes after
+    the per-page migration and crashed Engine init)."""
+    from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
+
+    cfg, params = tiny
+    eng = _engine(params, cfg, kv_quant=True, mesh=make_mesh(MeshPlan(tp=2)))
+    sp = SamplingParams(max_tokens=6, temperature=0.0, stop_token_ids=())
+    ref = _engine(params, cfg, kv_quant=True).generate([[1, 2, 3, 4]], sp)
+    got = eng.generate([[1, 2, 3, 4]], sp)
+    assert got[0].output_tokens == ref[0].output_tokens
