@@ -488,14 +488,17 @@ def _main() -> None:
         # continuous batching, qwen-deployment.yaml:32-33) — params are
         # already resident, so this costs only the engine compile + run
         if budget_allows("concurrent64-7b-int8", 300):
-            # decode_burst=8 (not 32): at 7B a 64-row burst iteration is
-            # ~35 ms, so a 32-step burst blocks prompt admission for >1 s
-            # and p50 TTFT measured 1.85 s; short bursts admit a prefill
-            # chunk every ~0.3 s (r04) — TTFT is this item's target,
-            # throughput is the bs=32 item's
+            # prefill_priority: under simultaneous 64-stream arrival the
+            # co-dispatched schedule interleaves a ~1 s decode burst
+            # between admission chunks and p50 TTFT measured 1.85 s
+            # (burst=8/chunk=512 was WORSE — 3.2 s — every extra dispatch
+            # pays tunnel RTT); prefill-prioritized admission finishes the
+            # whole prompt wave first.  TTFT is this item's target,
+            # throughput is the bs=32 item's.
             eng7c = Engine(params7, cfg7, max_num_seqs=64, num_pages=320,
-                           page_size=64, max_seq_len=1024, prefill_chunk=512,
-                           use_pallas=True, decode_burst=8)
+                           page_size=64, max_seq_len=1024, prefill_chunk=256,
+                           use_pallas=True, decode_burst=32,
+                           prefill_priority=True)
             log("bench[64seq-7b-int8]: warmup (compiles all row buckets)")
             eng7c.warmup()
             agg7, p507 = bench_concurrency(cfg7, streams=64, prompt_len=128,
@@ -631,6 +634,45 @@ def _main() -> None:
             jax.block_until_ready(params05)
         return params05
 
+    # ---- int8 KV cache in its WINNING regime: equal-HBM capacity ---------
+    # (VERDICT r03 #4a) pools sized to the SAME byte budget — bf16 160
+    # pages vs int8 320 (+1/128 scales) — under a workload needing ~40k
+    # cached tokens: the bf16 engine can only run ~16 of the 64 streams
+    # concurrently (admission queues on pages), int8 runs ~32.  With
+    # per-page scales the dequant tax is gone (the r03 per-token scale
+    # tiles cost 4.5x and buried this win), so doubled concurrency shows
+    # up as aggregate throughput.
+    if budget_allows("kvquant-capacity", 300):
+        agg_by = {}
+        for tag, quant, pages in (("bf16_160p", False, 160),
+                                  ("int8_320p", True, 320)):
+            engc = Engine(params05_or_init(), cfg05, max_num_seqs=64,
+                          num_pages=pages, page_size=64, max_seq_len=1024,
+                          prefill_chunk=256, use_pallas=True, decode_burst=32,
+                          kv_quant=quant)
+            log(f"bench[kvquant-capacity-{tag}]: warmup")
+            engc.warmup()
+            agg, p50 = bench_concurrency(cfg05, streams=64, prompt_len=512,
+                                         gen_tokens=128, engine=engc)
+            agg_by[tag] = agg
+            emit(f"kvquant_capacity_agg_tok_s_qwen2-0.5b_{tag}", agg, "tok/s",
+                 agg / BASELINE_TOK_S)
+            del engc
+            gc.collect()
+        emit("kvquant_equal_hbm_speedup_qwen2-0.5b",
+             agg_by["int8_320p"] / max(agg_by["bf16_160p"], 1e-9), "x", None)
+
+    # ---- speculative decoding in its acceptance regime -------------------
+    if budget_allows("spec-decode", 150):
+        (tpd, acc, spec_wall, burst_wall,
+         sburst_wall) = bench_spec_decode(params05_or_init(), cfg05)
+        emit("spec_decode_tok_per_dispatch_qwen2-0.5b", tpd, "tok/dispatch", None)
+        emit("spec_decode_acceptance_qwen2-0.5b", acc, "ratio", None)
+        emit("spec_decode_speedup_vs_burst_bs1", burst_wall / max(spec_wall, 1e-9),
+             "x", None)
+        emit("spec_burst_speedup_vs_burst_bs1_qwen2-0.5b",
+             burst_wall / max(sburst_wall, 1e-9), "x", None)
+
     # ---- eval configs #5 + #4 on 0.5B (continuity with r01/r02) ----------
     if budget_allows("concurrent64-0.5b", 180):
         eng = Engine(params05_or_init(), cfg05, max_num_seqs=64, num_pages=320, page_size=64,
@@ -670,45 +712,6 @@ def _main() -> None:
              BASELINE_TTFT_S / max(p50q, 1e-9))
         del engq
         gc.collect()
-
-    # ---- int8 KV cache in its WINNING regime: equal-HBM capacity ---------
-    # (VERDICT r03 #4a) pools sized to the SAME byte budget — bf16 160
-    # pages vs int8 320 (+1/128 scales) — under a workload needing ~40k
-    # cached tokens: the bf16 engine can only run ~16 of the 64 streams
-    # concurrently (admission queues on pages), int8 runs ~32.  With
-    # per-page scales the dequant tax is gone (the r03 per-token scale
-    # tiles cost 4.5x and buried this win), so doubled concurrency shows
-    # up as aggregate throughput.
-    if budget_allows("kvquant-capacity", 300):
-        agg_by = {}
-        for tag, quant, pages in (("bf16_160p", False, 160),
-                                  ("int8_320p", True, 320)):
-            engc = Engine(params05_or_init(), cfg05, max_num_seqs=64,
-                          num_pages=pages, page_size=64, max_seq_len=1024,
-                          prefill_chunk=256, use_pallas=True, decode_burst=32,
-                          kv_quant=quant)
-            log(f"bench[kvquant-capacity-{tag}]: warmup")
-            engc.warmup()
-            agg, p50 = bench_concurrency(cfg05, streams=64, prompt_len=512,
-                                         gen_tokens=128, engine=engc)
-            agg_by[tag] = agg
-            emit(f"kvquant_capacity_agg_tok_s_qwen2-0.5b_{tag}", agg, "tok/s",
-                 agg / BASELINE_TOK_S)
-            del engc
-            gc.collect()
-        emit("kvquant_equal_hbm_speedup_qwen2-0.5b",
-             agg_by["int8_320p"] / max(agg_by["bf16_160p"], 1e-9), "x", None)
-
-    # ---- speculative decoding in its acceptance regime -------------------
-    if budget_allows("spec-decode", 150):
-        (tpd, acc, spec_wall, burst_wall,
-         sburst_wall) = bench_spec_decode(params05_or_init(), cfg05)
-        emit("spec_decode_tok_per_dispatch_qwen2-0.5b", tpd, "tok/dispatch", None)
-        emit("spec_decode_acceptance_qwen2-0.5b", acc, "ratio", None)
-        emit("spec_decode_speedup_vs_burst_bs1", burst_wall / max(spec_wall, 1e-9),
-             "x", None)
-        emit("spec_burst_speedup_vs_burst_bs1_qwen2-0.5b",
-             burst_wall / max(sburst_wall, 1e-9), "x", None)
 
     # ---- ingest embedding chunks/sec -------------------------------------
     if budget_allows("embed", 60):

@@ -144,6 +144,12 @@ class Settings:
     prefix_caching: bool = field(
         default_factory=lambda: _env_bool("PREFIX_CACHING", True)
     )
+    # vLLM-style prefill-prioritized scheduling: give admission steps to
+    # prompt waves instead of interleaving decode bursts (p50 TTFT under
+    # simultaneous arrival; running streams stall during the wave)
+    prefill_priority: bool = field(
+        default_factory=lambda: _env_bool("PREFILL_PRIORITY", False)
+    )
     # prompts at least this long prefill sequence-parallel over the mesh's
     # sp axis (serving/long_prefill.py); 0 disables
     sp_prefill_threshold: int = field(

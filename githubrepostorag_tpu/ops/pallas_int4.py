@@ -144,20 +144,23 @@ def _w4a8_kernel(*refs, half: int, n_gt: int, layered: bool, sliced: bool):
     def _():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    ot = pq.shape[-1]
-    # int32 widen (Mosaic legalizes neither uint8 shifts nor narrow
-    # casts), then straight to int8 nibble values — no bf16 anywhere
-    pq32 = pq.astype(jnp.int32)
-    lo8 = (pq32 & 0x0F).astype(jnp.int8).reshape(n_gt, half, ot)
-    hi8 = (pq32 >> 4).astype(jnp.int8).reshape(n_gt, half, ot)
     s_f = s.astype(jnp.float32)  # [n_gt, OT]
     dn = (((1,), (0,)), ((), ()))
     for g in range(n_gt):  # static unroll: n_gt <= 16 by tile choice
+        # unpack PER GROUP ([half, OT] at a time, static 32-row slices):
+        # a whole-tile int32 widen materializes it/2 x OT x 4B of VMEM and
+        # capped OT at ~1k for the big projections (259 grid steps for
+        # wgu); group-at-a-time intermediates are ~100x smaller, so OT can
+        # cover 4-9k columns and the grid shrinks ~10x.  int32 widen
+        # because Mosaic legalizes neither uint8 shifts nor narrow casts.
+        pq32 = pq[g * half : (g + 1) * half].astype(jnp.int32)
+        lo8 = (pq32 & 0x0F).astype(jnp.int8)
+        hi8 = (pq32 >> 4).astype(jnp.int8)
         pa = jax.lax.dot_general(
-            xa_ref[g], lo8[g], dn, preferred_element_type=jnp.int32
+            xa_ref[g], lo8, dn, preferred_element_type=jnp.int32
         )
         pb = jax.lax.dot_general(
-            xb_ref[g], hi8[g], dn, preferred_element_type=jnp.int32
+            xb_ref[g], hi8, dn, preferred_element_type=jnp.int32
         )
         acc_ref[...] += (pa + pb).astype(jnp.float32) * s_f[g][None, :]
 
@@ -167,15 +170,19 @@ def _w4a8_kernel(*refs, half: int, n_gt: int, layered: bool, sliced: bool):
 
 
 def _tiles_and_maps(in_dim: int, out: int, gsz: int, n_g: int,
-                    layered: bool, layer):
+                    layered: bool, layer, wide_ot: bool = False):
     """Tile sizes + (q, s) block specs shared by both int4 routes: the
     in-tile is a multiple of 8 GROUPS (scale slice offsets must be provable
     sublane multiples; single in-tile when it falls back to the whole input
     dim), and stacked weights address (layer, tile) through the prefetched
-    scalar so the layer loop never materializes a per-layer copy."""
+    scalar so the layer loop never materializes a per-layer copy.
+    ``wide_ot``: the W4A8 route unpacks per group (no whole-tile int32
+    materialization), so its OT budget is ~4x the bf16-dequant route's —
+    which matters: a wider OT shrinks the grid (fewer per-step fixed
+    costs) ~10x for the 19k/38k-column projections."""
     it = _pick_tile(in_dim, gsz * 8, 1024)
-    # VMEM budget: unpacked w tile + packed tile + f32 acc
-    ot = _pick_tile(out, 1, max(512, (3 * 2**20) // (2 * it)))
+    ot_budget = (6 * 2**20) // it if wide_ot else (3 * 2**20) // (2 * it)
+    ot = _pick_tile(out, 1, max(512, ot_budget))
     n_gt = it // gsz
 
     def out_map(mi, oi, ii, *refs):
@@ -260,7 +267,7 @@ def _w4a8_matmul(x, q, s, zs, layer, out_dtype, interpret: bool):
         xb = jnp.pad(xb, ((0, 0), (0, m_padded - m), (0, 0)))
 
     it, ot, n_gt, out_map, q_map, s_map, q_block, s_block, scalars = \
-        _tiles_and_maps(in_dim, out, gsz, n_g, layered, layer)
+        _tiles_and_maps(in_dim, out, gsz, n_g, layered, layer, wide_ot=True)
     grid = (m_padded // mt, out // ot, in_dim // it)
 
     def x_map(mi, oi, ii, *refs):

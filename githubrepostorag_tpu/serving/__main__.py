@@ -110,6 +110,7 @@ async def serve(host: str, port: int) -> None:
             kv_quant=s.kv_quant,
             mesh=mesh,
             prefix_caching=s.prefix_caching,
+            prefill_priority=s.prefill_priority,
             sp_prefill_threshold=s.sp_prefill_threshold or None,
             spec_ngram_k=s.spec_ngram_k,
             spec_burst_iters=s.spec_burst_iters,

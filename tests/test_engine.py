@@ -378,3 +378,22 @@ def test_cancelled_pending_first_wave_does_not_corrupt_others(tiny):
     assert done[r2].finish_reason == "cancelled"
     # the victim stream must be byte-identical to its solo run
     assert done[r1].output_tokens == solo.output_tokens
+
+
+def test_prefill_priority_same_outputs(tiny):
+    """prefill_priority is a SCHEDULING change only: a wave of requests
+    admitted together produces the same tokens as the co-dispatched
+    default, and no deadlock occurs when the wave exceeds rows/pages."""
+    _, params, cfg = tiny
+    from githubrepostorag_tpu.serving import Engine, SamplingParams
+
+    prompts = [[(7 * i + j) % cfg.vocab_size for j in range(6 + i)]
+               for i in range(6)]
+    sp = SamplingParams(max_tokens=10, temperature=0.0, stop_token_ids=())
+
+    def run(**kw):
+        eng = Engine(params, cfg, max_num_seqs=2, num_pages=16, page_size=4,
+                     max_seq_len=32, kv_dtype=jnp.float32, decode_burst=4, **kw)
+        return [r.output_tokens for r in eng.generate(prompts, sp)]
+
+    assert run(prefill_priority=True) == run()
