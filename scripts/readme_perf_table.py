@@ -55,15 +55,22 @@ def build_table(records: list[dict]) -> str:
         row("Qwen2-1.5B decode, bs=8 / bs=32", summary,
             ["decode_tok_s_per_chip_qwen2-1.5b_bs8",
              "decode_tok_s_per_chip_qwen2-1.5b_bs32"], "tok/s", vs, extras),
+        row("Qwen2-1.5B int8 decode, bs=8 (latency mode)", summary,
+            ["decode_tok_s_per_chip_qwen2-1.5b_int8_bs8"], "tok/s", vs, extras),
         row("64 concurrent streams agg (0.5B / 1.5B)", summary,
             ["concurrent64_agg_tok_s_qwen2-0.5b",
              "concurrent64_agg_tok_s_qwen2-1.5b"], "tok/s", vs, extras),
         row("Prefix cache warm/cold TTFT ratio (1.5B, 3.5k prefix)", summary,
             ["prefix_cache_warm_over_cold_qwen2-1.5b"], "", vs, extras),
-        row("Spec decode speedup vs burst (0.5B / 1.5B)", summary,
+        row("FUSED spec-burst speedup vs plain burst (0.5B / 1.5B)", summary,
+            ["spec_burst_speedup_vs_burst_bs1_qwen2-0.5b",
+             "spec_burst_speedup_vs_burst_bs1_qwen2-1.5b"], "×", vs, extras),
+        row("Host-dispatched spec vs burst (0.5B / 1.5B; RTT-bound)", summary,
             ["spec_decode_speedup_vs_burst_bs1",
              "spec_decode_speedup_vs_burst_bs1_qwen2-1.5b"], "×", vs, extras),
-        row("KV-quant capacity regime agg (0.5B)", summary,
+        row("KV-quant equal-HBM capacity speedup (0.5B)", summary,
+            ["kvquant_equal_hbm_speedup_qwen2-0.5b"], "×", vs, extras),
+        row("KV-quant same-geometry agg, conc64 (0.5B)", summary,
             ["concurrent64_agg_tok_s_qwen2-0.5b_kvquant_int8"], "tok/s", vs, extras),
         row("1k-doc extractor batch (0.5B)", summary,
             ["extractor_batch1k_docs_s_qwen2-0.5b"], "docs/s", vs, extras),
