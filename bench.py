@@ -495,10 +495,13 @@ def _main() -> None:
             # pays tunnel RTT); prefill-prioritized admission finishes the
             # whole prompt wave first.  TTFT is this item's target,
             # throughput is the bs=32 item's.
+            # prefill_widths=2: the 128-token prompts dispatch at width 128
+            # instead of padding to the 256 chunk — halves the prompt-wave
+            # FLOPs that dominate p50 TTFT under simultaneous arrival
             eng7c = Engine(params7, cfg7, max_num_seqs=64, num_pages=320,
                            page_size=64, max_seq_len=1024, prefill_chunk=256,
                            use_pallas=True, decode_burst=32,
-                           prefill_priority=True)
+                           prefill_priority=True, prefill_widths=2)
             log("bench[64seq-7b-int8]: warmup (compiles all row buckets)")
             eng7c.warmup()
             agg7, p507 = bench_concurrency(cfg7, streams=64, prompt_len=128,
@@ -581,7 +584,7 @@ def _main() -> None:
     if params15 is not None and budget_allows("concurrent64-1.5b", 180):
         eng15c = Engine(params15, cfg15, max_num_seqs=64, num_pages=320,
                         page_size=64, max_seq_len=1024, prefill_chunk=256,
-                        use_pallas=True, decode_burst=32)
+                        use_pallas=True, decode_burst=32, prefill_widths=2)
         log("bench[64seq-1.5b]: warmup (compiles all row buckets)")
         eng15c.warmup()
         agg15, p5015 = bench_concurrency(cfg15, streams=64, prompt_len=128,
@@ -677,7 +680,7 @@ def _main() -> None:
     if budget_allows("concurrent64-0.5b", 180):
         eng = Engine(params05_or_init(), cfg05, max_num_seqs=64, num_pages=320, page_size=64,
                      max_seq_len=1024, prefill_chunk=256, use_pallas=True,
-                     decode_burst=32)
+                     decode_burst=32, prefill_widths=2)
         log("bench[64seq]: warmup (compiles all row buckets)")
         eng.warmup()
 
@@ -701,7 +704,8 @@ def _main() -> None:
     if budget_allows("concurrent64-kvq", 180):
         engq = Engine(params05_or_init(), cfg05, max_num_seqs=64, num_pages=320,
                       page_size=64, max_seq_len=1024, prefill_chunk=256,
-                      use_pallas=True, decode_burst=32, kv_quant=True)
+                      use_pallas=True, decode_burst=32, kv_quant=True,
+                      prefill_widths=2)
         log("bench[64seq-kvquant]: warmup (compiles all row buckets)")
         engq.warmup()
         aggq, p50q = bench_concurrency(cfg05, streams=64, prompt_len=128,

@@ -135,6 +135,12 @@ class Settings:
     kv_num_pages: int = field(default_factory=lambda: _env_int("KV_NUM_PAGES", 2048))
     max_num_seqs: int = field(default_factory=lambda: _env_int("MAX_NUM_SEQS", 64))
     prefill_chunk: int = field(default_factory=lambda: _env_int("PREFILL_CHUNK", 512))
+    # number of power-of-two prefill dispatch widths (chunk, chunk/2, ...)
+    # warmed and used; >1 stops short prompts paying full-chunk prefill
+    # FLOPs as padding (serving/engine.py prefill_widths)
+    prefill_widths: int = field(
+        default_factory=lambda: _env_int("PREFILL_WIDTHS", 1)
+    )
     # "native" = in-tree C++ byte-level BPE (serving/bpe_native.py) when the
     # checkpoint has a tokenizer.json; "hf" = transformers AutoTokenizer
     tokenizer_backend: str = field(

@@ -106,6 +106,7 @@ async def serve(host: str, port: int) -> None:
             page_size=s.kv_page_size,
             max_seq_len=s.context_window,
             prefill_chunk=s.prefill_chunk,
+            prefill_widths=s.prefill_widths,
             use_pallas=jax.default_backend() == "tpu",
             kv_quant=s.kv_quant,
             mesh=mesh,

@@ -100,6 +100,18 @@ class Engine:
         page_size: int = 16,
         max_seq_len: int = 2048,
         prefill_chunk: int = 512,
+        prefill_widths: int = 1,  # number of power-of-two prefill dispatch
+        # widths to compile and use: 1 = every chunk dispatches at
+        # prefill_chunk (today's single-shape discipline); k>1 adds the
+        # k-1 next-smaller buckets (chunk/2, chunk/4, ...) and each wave
+        # dispatches at the smallest bucket covering its longest pending
+        # chunk.  Short prompts (RAG chat queries are ~100-300 tokens vs
+        # a 256-512 chunk) stop paying the full chunk width in prefill
+        # FLOPs — under simultaneous 64-stream arrival that padding was
+        # most of p50 TTFT (BENCH r04: prompt 128, chunk 256 -> half the
+        # 7B prefill wave computed on padding).  warmup() compiles every
+        # (row bucket x width bucket) pair so live traffic stays on
+        # warmed shapes.
         kv_dtype=jnp.bfloat16,
         kv_quant: bool = False,  # int8 KV pages with per-page scales —
         # halves cache reads and doubles page capacity
@@ -161,6 +173,15 @@ class Engine:
         self.max_seq_len = max_seq_len
         self.max_pages_per_seq = pages_needed(max_seq_len, page_size)
         self.prefill_chunk = prefill_chunk
+        # dispatch-width buckets, largest first: [chunk, chunk/2, ...];
+        # never below the page size (slot mappings stay page-aligned and
+        # the marginal FLOP saving below one page is noise)
+        self.prefill_width_buckets = [prefill_chunk]
+        for _ in range(max(1, prefill_widths) - 1):
+            half = self.prefill_width_buckets[-1] // 2
+            if half < max(page_size, 16):
+                break
+            self.prefill_width_buckets.append(half)
         self.use_pallas = use_pallas
         # decode iterations fused per device dispatch (serving/decode_burst.py);
         # 1 reproduces plain per-token stepping
@@ -399,6 +420,17 @@ class Engine:
             and len(req.prompt) >= self.sp_prefill_threshold
         )
 
+    def _dispatch_width(self, longest_chunk: int) -> int:
+        """Prefill dispatch width for a wave whose longest pending chunk is
+        ``longest_chunk``: the smallest warmed width bucket covering it.
+        The ONLY width-selection rule — warmup() predicts shapes with the
+        same call, so the two can never desynchronize."""
+        width = self.prefill_chunk
+        for w in self.prefill_width_buckets:  # largest -> smallest
+            if w >= longest_chunk:
+                width = w
+        return width
+
     def _head_need_hashes(self, req: _Request) -> tuple[int, list[bytes]]:
         """Total page need for ``req`` and the chain hashes of the prefix
         pages an admission would be allowed to share (capped so at least one
@@ -451,6 +483,7 @@ class Engine:
             if not can_free and self._admission_feasible():
                 self._drain_chain(finished)
         # admit as many waiting requests as rows + pages allow
+        cached_admits: list[_Request] = []  # batched presence marking below
         while self._waiting and self._free_rows:
             req = self._waiting[0]
             need, hashes = self._head_need_hashes(req)
@@ -479,18 +512,31 @@ class Engine:
             self._row_limits[row] = min(len(pages) * self.page_size, self.max_seq_len - 1)
             self._set_row_sampling(row, req.sampling)
             if req.cached_tokens:
-                # the skipped prefix still counts for repetition penalty:
-                # mark its tokens in the presence mask (fixed [1, max_seq]
-                # shape -> one compiled program regardless of hit length)
-                ids = np.zeros((1, self.max_seq_len), dtype=np.int32)
-                ids[0, : req.cached_tokens] = req.prompt[: req.cached_tokens]
-                self._presence = _mark_presence_chunks(
-                    self._presence,
-                    jnp.asarray([row], dtype=jnp.int32),
-                    jnp.asarray(ids),
-                    jnp.asarray([req.cached_tokens], dtype=jnp.int32),
-                    self.cfg.vocab_size,
-                )
+                cached_admits.append(req)
+        if cached_admits:
+            # skipped prefixes still count for repetition penalty: mark
+            # their tokens in the presence mask — ONE batched dispatch per
+            # admission wave at a power-of-two row bucket (the per-request
+            # [1, max_seq] call made a warm 64-stream wave pay 64
+            # sequential device round-trips, measurably WORSE TTFT than
+            # the cache-miss path through a remote-TPU tunnel; bucketing
+            # keeps the single-hit payload at [1, max_seq], not
+            # [max_num_seqs, max_seq])
+            nr = _bucket(len(cached_admits), self.max_num_seqs, minimum=1)
+            ids = np.zeros((nr, self.max_seq_len), dtype=np.int32)
+            rows = np.zeros((nr,), dtype=np.int32)
+            lens = np.zeros((nr,), dtype=np.int32)
+            for i, req in enumerate(cached_admits):
+                ids[i, : req.cached_tokens] = req.prompt[: req.cached_tokens]
+                rows[i] = req.row
+                lens[i] = req.cached_tokens
+            self._presence = _mark_presence_chunks(
+                self._presence,
+                jnp.asarray(rows),
+                jnp.asarray(ids),
+                jnp.asarray(lens),
+                self.cfg.vocab_size,
+            )
         prefilling = [r for r in self._row_req.values() if r.state == "prefilling"]
         if not prefilling:
             return False
@@ -515,12 +561,15 @@ class Engine:
         burst, so admissions never stall running streams on a host sync."""
         others_running = any(r.state == "running" for r in self._row_req.values())
         n = len(reqs)
-        # Shape discipline: row count buckets to powers of two, width is
-        # ALWAYS prefill_chunk.  Every distinct device shape is a multi-second
-        # XLA compile; steady-state traffic must only ever see shapes that
-        # warmup() has already compiled.
+        # Shape discipline: row count buckets to powers of two, width comes
+        # from the fixed prefill_width_buckets set (a single value —
+        # prefill_chunk — unless prefill_widths > 1).  Every distinct device
+        # shape is a multi-second XLA compile; steady-state traffic must
+        # only ever see shapes that warmup() has already compiled.
         rb = _bucket(n, self.max_num_seqs, minimum=1)
-        width = self.prefill_chunk
+        width = self._dispatch_width(
+            max(min(len(r.prompt) - r.prefill_pos, self.prefill_chunk) for r in reqs)
+        )
 
         ids = np.zeros((rb, width), dtype=np.int32)
         pos = np.zeros((rb, width), dtype=np.int32)
@@ -1073,9 +1122,38 @@ class Engine:
                 break
             b *= 2
         sp = SamplingParams(max_tokens=2, temperature=0.0, stop_token_ids=())
+        wave = 0  # distinct prompt content per wave: identical prompts
+        # across waves would hit the prefix cache and resume PAST the
+        # prefill program this wave is meant to compile
+        seen: set[tuple[int, int]] = set()  # (row bucket, width) dispatched
         for nb in buckets:
-            prompts = [[1, 2, 3]] * nb
-            self.generate(prompts, sp)
+            for w in self.prefill_width_buckets:
+                # ONE long prompt selects width bucket w; the other nb-1
+                # rows stay short, so the page pool never forces the wave
+                # into a smaller shape than live traffic could hit (a
+                # heterogeneous live wave needs only one long prompt to
+                # dispatch at (nb, w) — warmup must cover exactly that)
+                short_pages = pages_needed(3 + sp.max_tokens, self.page_size)
+                long_budget = (
+                    self._allocator.num_pages - (nb - 1) * short_pages
+                ) * self.page_size - sp.max_tokens
+                plen = min(w, self.max_seq_len - 3, long_budget)
+                if self.sp_prefill_threshold is not None and self._sp > 1:
+                    # stay below the ring-prefill routing threshold — this
+                    # loop warms the CHUNKED shapes; ring widths are warmed
+                    # by the dedicated loop below
+                    plen = min(plen, self.sp_prefill_threshold - 1)
+                if plen <= 0:
+                    continue
+                # the width this wave will actually dispatch at (page caps
+                # can collapse several w's onto one shape — run it once)
+                dw = self._dispatch_width(min(plen, self.prefill_chunk))
+                if (nb, dw) in seen:
+                    continue
+                seen.add((nb, dw))
+                wave += 1
+                tok = 2 + wave % max(2, self.cfg.vocab_size - 2)
+                self.generate([[tok] * plen] + [[tok] * 3] * (nb - 1), sp)
         if self.sp_prefill_threshold is not None and self._sp > 1:
             # precompile the ring-prefill program at every width bucket a
             # live prompt can hit (ADVICE r02: without this, the first
@@ -1094,15 +1172,18 @@ class Engine:
                     break
                 width *= 2
         if self.prefix_caching:
-            # the cached-prefix presence-marking program ([1, max_seq] shape)
-            # only runs on cache hits; compile it now with a zero-length mark
-            self._presence = _mark_presence_chunks(
-                self._presence,
-                jnp.zeros((1,), dtype=jnp.int32),
-                jnp.zeros((1, self.max_seq_len), dtype=jnp.int32),
-                jnp.zeros((1,), dtype=jnp.int32),
-                self.cfg.vocab_size,
-            )
+            # the cached-prefix presence-marking program ([row bucket,
+            # max_seq] — one dispatch per admission wave) only runs on
+            # cache hits; compile every row bucket now with zero-length
+            # marks (each is a trivial scatter — compiles are cheap)
+            for nb in buckets:
+                self._presence = _mark_presence_chunks(
+                    self._presence,
+                    jnp.zeros((nb,), dtype=jnp.int32),
+                    jnp.zeros((nb, self.max_seq_len), dtype=jnp.int32),
+                    jnp.zeros((nb,), dtype=jnp.int32),
+                    self.cfg.vocab_size,
+                )
         logger.info("engine warmup complete (%d prefill row buckets)", len(buckets))
 
     def generate(

@@ -79,6 +79,25 @@ def test_chunked_prefill_long_prompt(tiny):
     assert res.output_tokens == _hf_greedy(model, prompt, 5)
 
 
+def test_width_bucketed_prefill_matches_hf(tiny):
+    """prefill_widths > 1 dispatches short waves at sub-chunk widths (the
+    p50-TTFT fix for eval config #5) — tokens must be identical to the
+    single-width engine and to HF, across short, bucket-boundary, and
+    multi-chunk (resume) prompts, mixed in one batch."""
+    model, params, cfg = tiny
+    rng = np.random.default_rng(7)
+    # chunk=32 -> buckets [32, 16] (floored at 16): 5 -> 16, 16 -> 16,
+    # 17 -> 32, 70 -> chunks 32+32+6 (the 6-token resume chunk rides a
+    # 16-wide wave)
+    prompts = [rng.integers(0, cfg.vocab_size, size=n).tolist() for n in (5, 16, 17, 70)]
+    eng = _make_engine(params, cfg, prefill_widths=3)
+    assert eng.prefill_width_buckets == [32, 16]
+    eng.warmup()
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    for prompt, res in zip(prompts, eng.generate(prompts, sp)):
+        assert res.output_tokens == _hf_greedy(model, prompt, 8)
+
+
 def test_streaming_callback_order(tiny):
     _, params, cfg = tiny
     eng = _make_engine(params, cfg)
