@@ -111,12 +111,14 @@ def sample_tokens_capped(
         # knob controls the internal oversampling), so its output is
         # already what a second lax.top_k would produce — device profiling
         # showed that redundant second sort costing ~0.1 ms/decode step.
-        # Pull 2*cap candidates and slice the (exactly sorted) first cap:
-        # same candidate recall as the r02 approx(2*cap)+top_k(cap) scheme
-        # at a fraction of the old second sort's cost
-        pool = min(2 * cap, vocab)
-        vals, idx = jax.lax.approx_max_k(scaled, pool, recall_target=0.99)
-        vals, idx = vals[:, :cap], idx[:, :cap].astype(jnp.int32)
+        # Pull exactly cap candidates: the in-burst aggregate sort scales
+        # with the pull size (real-chip scan bench: pool=2*cap costs
+        # ~0.17 ms/step more than pool=cap at bs8), and each true top-cap
+        # candidate still lands in the pull with >= recall_target
+        # probability.  SAMPLING_EXACT_TOPK=1 below remains the exactness
+        # escape hatch.
+        vals, idx = jax.lax.approx_max_k(scaled, cap, recall_target=0.99)
+        idx = idx.astype(jnp.int32)
     # top-k within the cap: positions >= k masked (k<=0 disables)
     ranks = jnp.arange(cap)[None, :]
     k_arr = top_k[:, None]
@@ -129,6 +131,27 @@ def sample_tokens_capped(
     choice = jax.random.categorical(rng, vals, axis=-1)  # [B] index into cap
     sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def sample_tokens_nofilter(
+    logits: jnp.ndarray,  # [B, V] float32
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B] — 0 means greedy
+    repetition_penalty: jnp.ndarray,  # [B]
+    presence: jnp.ndarray,  # [B, V] bool
+) -> jnp.ndarray:
+    """Sampling fast path for rows with top_p >= 1 and top_k <= 0 (the
+    default API sampling config): ``jax.random.categorical`` over the full
+    vocab is exactly Gumbel-argmax — one fused reduce, no approx_max_k
+    candidate pull and no sort.  The candidate sort costs ~0.23 ms per
+    decode step at bs8 on v5e (device trace: ``sort.9``), and grows with
+    the row count; the engine selects this variant per burst from its
+    host-side sampling mirrors (serving/engine.py _decode_step)."""
+    logits = apply_repetition_penalty(logits, presence, repetition_penalty[:, None])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 

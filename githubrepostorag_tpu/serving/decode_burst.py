@@ -48,7 +48,10 @@ from githubrepostorag_tpu.ops.attention import dense_attention
 from githubrepostorag_tpu.ops.paged_attention import gather_kv
 from githubrepostorag_tpu.ops.pallas_paged import paged_attention_decode_staged
 from githubrepostorag_tpu.ops.rope import rope_cos_sin
-from githubrepostorag_tpu.ops.sampling import sample_tokens_capped
+from githubrepostorag_tpu.ops.sampling import (
+    sample_tokens_capped,
+    sample_tokens_nofilter,
+)
 
 
 def _staged_attend_tp(mesh, interpret, quant: bool = False):
@@ -92,7 +95,10 @@ def _staged_attend_tp(mesh, interpret, quant: bool = False):
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "use_pallas", "mesh"),
+    static_argnames=(
+        "cfg", "n_steps", "use_pallas", "mesh", "layer_unroll",
+        "filter_sampling",
+    ),
     donate_argnums=(4, 5, 6),
 )
 def decode_burst(
@@ -114,6 +120,15 @@ def decode_burst(
     n_steps: int,
     use_pallas: bool = False,
     mesh=None,  # jax.sharding.Mesh with a tp axis -> TP-sharded attention
+    layer_unroll: int = 1,  # lax.scan unroll factor for the layer loop —
+    # at small batch the decode step is weight-stream-bound and the scan's
+    # per-iteration bookkeeping is a fixed ~tens-of-us tax x num_layers;
+    # unrolling lets XLA overlap layer i+1's weight prefetch with layer
+    # i's compute and drops the loop overhead
+    filter_sampling: bool = True,  # False = every running row has
+    # top_p >= 1 and top_k <= 0, so sampling takes the sort-free
+    # Gumbel-argmax path (ops/sampling.sample_tokens_nofilter); the
+    # engine decides per burst from its host-side sampling mirrors
     k_scales: jnp.ndarray | None = None,  # [L, n_kv, P] f32: int8 (kv_quant)
     v_scales: jnp.ndarray | None = None,  # pools' per-PAGE dequant scales
 ):
@@ -259,13 +274,21 @@ def decode_burst(
 
         (h, staged_k, staged_v, _), _ = jax.lax.scan(
             layer_body, (h, staged_k, staged_v, 0), layer_xs,
+            unroll=min(max(1, layer_unroll), L),
         )
         logits = _logits(params, h, int4_kernel=int4_kernel)
 
-        toks = sample_tokens_capped(
-            logits[:, 0], step_rng, temperature, top_p, top_k,
-            repetition_penalty, pres,
-        )
+        if filter_sampling:
+            toks = sample_tokens_capped(
+                logits[:, 0], step_rng, temperature, top_p, top_k,
+                repetition_penalty, pres,
+            )
+        else:
+            # no running row filters: Gumbel-argmax over the full vocab,
+            # skipping the candidate sort (ops/sampling.py)
+            toks = sample_tokens_nofilter(
+                logits[:, 0], step_rng, temperature, repetition_penalty, pres,
+            )
         toks = jnp.where(act, toks, last)
         pres = pres.at[rows, toks].max(act)
         lens = lens + act.astype(jnp.int32)

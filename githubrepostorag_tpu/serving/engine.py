@@ -120,6 +120,10 @@ class Engine:
         use_pallas: bool = False,
         rng_seed: int = 0,
         decode_burst: int = 8,
+        layer_unroll: int = 1,  # unroll factor for the decode burst's
+        # layer scan (serving/decode_burst.py) — small-batch decode is
+        # weight-stream-bound and the per-layer scan bookkeeping is a
+        # fixed tax; >1 trades compile time for step latency
         mesh=None,  # jax.sharding.Mesh -> TP-shard params, KV pools, compute
         prefix_caching: bool = True,  # vLLM automatic-prefix-caching analog
         prefill_priority: bool = False,  # skip the decode burst on steps
@@ -186,6 +190,7 @@ class Engine:
         # decode iterations fused per device dispatch (serving/decode_burst.py);
         # 1 reproduces plain per-token stepping
         self.decode_burst = max(1, decode_burst)
+        self.layer_unroll = max(1, layer_unroll)
 
         self.kv_quant = kv_quant
         # int4 weights route to the Pallas GEMM only when unsharded (an
@@ -797,6 +802,19 @@ class Engine:
                 jnp.asarray(self._block_tables), key,
                 self._temp_d, self._top_p_d, self._top_k_d, self._rep_pen_d,
                 n_steps=n_steps, use_pallas=self.use_pallas, mesh=self.mesh,
+                layer_unroll=self.layer_unroll,
+                # sort-free sampling whenever no SAMPLING row filters —
+                # greedy rows (temp <= 0) take the exact argmax regardless
+                # of their top_p/top_k, so an all-greedy batch (e.g. the
+                # ingest extractors) skips the candidate sort even at the
+                # default top_p=0.9.  Free rows are reset at release, so
+                # this is exactly the running set.
+                filter_sampling=bool(
+                    np.any(
+                        (self._temp > 0.0)
+                        & ((self._top_p < 1.0) | (self._top_k > 0))
+                    )
+                ),
                 k_scales=self._k_scales, v_scales=self._v_scales,
             )
             if self.kv_quant:
@@ -1079,6 +1097,18 @@ class Engine:
             self._seq_lens[req.row] = 0
             self._block_tables[req.row] = 0
             self._row_limits[req.row] = 0
+            # reset the HOST sampling mirrors to the no-filter defaults so
+            # a stale top_p/top_k on a FREE row can't pin later bursts onto
+            # the filtered (sort-carrying) sampling variant.  Deliberately
+            # NOT marking _sampling_dirty: the device-side params of a
+            # freed row are never read (its burst tokens are discarded via
+            # the act mask) and _set_row_sampling dirties before any
+            # reassignment — pushing four arrays per completed request
+            # would put needless transfers on the hot burst path
+            self._temp[req.row] = 1.0
+            self._top_p[req.row] = 1.0
+            self._top_k[req.row] = 0
+            self._rep_pen[req.row] = 1.0
             req.row = -1
         req.state = "done"
 
@@ -1154,6 +1184,14 @@ class Engine:
                 wave += 1
                 tok = 2 + wave % max(2, self.cfg.vocab_size - 2)
                 self.generate([[tok] * plen] + [[tok] * 3] * (nb - 1), sp)
+        # both burst sampling variants must be warm: the bucket loop above
+        # compiled the no-filter (Gumbel-argmax) burst; one filtered request
+        # compiles the sample_tokens_capped burst
+        self.generate(
+            [[9, 8, 7]],
+            SamplingParams(max_tokens=2, temperature=0.7, top_p=0.9,
+                           stop_token_ids=()),
+        )
         if self.sp_prefill_threshold is not None and self._sp > 1:
             # precompile the ring-prefill program at every width bucket a
             # live prompt can hit (ADVICE r02: without this, the first

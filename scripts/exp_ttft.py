@@ -28,7 +28,6 @@ params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
 jax.block_until_ready(params)
 
 rng = np.random.default_rng(1)
-prompts = [rng.integers(0, cfg.vocab_size, size=128).tolist() for _ in range(64)]
 sp = SamplingParams(max_tokens=128, temperature=0.7, stop_token_ids=())
 
 for pw in widths:
@@ -39,6 +38,10 @@ for pw in widths:
     eng.warmup()
     t_warm = time.monotonic() - t0
     for trial in range(2):  # trial 0 warms any residual state; keep trial 1
+        # FRESH prompts per trial: reusing trial 0's prompts would hit the
+        # prefix cache and measure a half-cached wave, not eval config #5
+        prompts = [rng.integers(0, cfg.vocab_size, size=128).tolist()
+                   for _ in range(64)]
         t0 = time.monotonic()
         results = eng.generate(prompts, sp)
         wall = time.monotonic() - t0
