@@ -125,6 +125,15 @@ def _w4a8_kernel(*refs, half: int, n_gt: int, layered: bool, sliced: bool):
     [MT, OT] partial (1/gsz of the weight elements per group) and the
     zero-point term leaves the kernel entirely (wrapper-side XLA dot).
 
+    Both nibble planes stack into ONE [gsz, OT] int8 operand per group
+    (a VMEM scratch written with two static half-slices), so the group
+    dot runs at the full K=gsz MXU depth: the in-group plane packing puts
+    plane rows at original positions [g*gsz, g*gsz+half) and
+    [g*gsz+half, (g+1)*gsz), i.e. stacked [lo; hi] IS group g's rows in
+    natural order, matching the wrapper's group-major activations.  The
+    earlier two-dots-per-group form (one per plane) halved MXU weight
+    throughput: a K=half dot occupies the same systolic passes as K=gsz.
+
     Accuracy contract: activations are quantized per token row to
     symmetric int8 (the wrapper's x/amax*127), so results differ from the
     bf16-dequant math by the activation-quant error (~1e-2 relative) —
@@ -132,11 +141,11 @@ def _w4a8_kernel(*refs, half: int, n_gt: int, layered: bool, sliced: bool):
     ii = pl.program_id(2)
     n_ii = pl.num_programs(2)
     if layered:
-        (_li_ref, xa_ref, xb_ref, q_ref, s_ref, out_ref, acc_ref) = refs
+        (_li_ref, x_ref, q_ref, s_ref, out_ref, acc_ref, w_ref) = refs
         pq = q_ref[0]  # [IT/2, OT] uint8
         s = s_ref[0, pl.ds(ii * n_gt, n_gt)] if sliced else s_ref[0]
     else:
-        (xa_ref, xb_ref, q_ref, s_ref, out_ref, acc_ref) = refs
+        (x_ref, q_ref, s_ref, out_ref, acc_ref, w_ref) = refs
         pq = q_ref[...]
         s = s_ref[pl.ds(ii * n_gt, n_gt)] if sliced else s_ref[...]
 
@@ -154,15 +163,12 @@ def _w4a8_kernel(*refs, half: int, n_gt: int, layered: bool, sliced: bool):
         # cover 4-9k columns and the grid shrinks ~10x.  int32 widen
         # because Mosaic legalizes neither uint8 shifts nor narrow casts.
         pq32 = pq[g * half : (g + 1) * half].astype(jnp.int32)
-        lo8 = (pq32 & 0x0F).astype(jnp.int8)
-        hi8 = (pq32 >> 4).astype(jnp.int8)
-        pa = jax.lax.dot_general(
-            xa_ref[g], lo8, dn, preferred_element_type=jnp.int32
+        w_ref[:half] = (pq32 & 0x0F).astype(jnp.int8)
+        w_ref[half:] = (pq32 >> 4).astype(jnp.int8)
+        p = jax.lax.dot_general(
+            x_ref[g], w_ref[...], dn, preferred_element_type=jnp.int32
         )
-        pb = jax.lax.dot_general(
-            xb_ref[g], hi8, dn, preferred_element_type=jnp.int32
-        )
-        acc_ref[...] += (pa + pb).astype(jnp.float32) * s_f[g][None, :]
+        acc_ref[...] += p.astype(jnp.float32) * s_f[g][None, :]
 
     @pl.when(ii == n_ii - 1)
     def _():
@@ -214,9 +220,9 @@ def _tiles_and_maps(in_dim: int, out: int, gsz: int, n_g: int,
 def _w4a8_matmul(x, q, s, zs, layer, out_dtype, interpret: bool):
     """The W4A8 route of ``int4_matmul`` (decode-sized batches).  The
     wrapper quantizes activations to per-row int8, lays them out
-    group-major ([n_g, M, half] per nibble plane — static leading-axis
-    indexing; in-kernel lane slicing at half-multiples is not
-    128-aligned), and folds the zero-point term into one small XLA dot:
+    group-major ([n_g, M, gsz] — static leading-axis indexing; in-kernel
+    lane slicing at sub-128 offsets is not Mosaic-legal), and folds the
+    zero-point term into one small XLA dot:
 
         y[m,o] = sxn[m] * (Sum_g s[g,o]*P[g,m,o] - Sum_g R[m,g]*zs[g,o])
 
@@ -256,15 +262,14 @@ def _w4a8_matmul(x, q, s, zs, layer, out_dtype, interpret: bool):
         (((1,), (0,)), ((), ())),
     )  # [m, out]
 
-    # group-major nibble-plane layout for the kernel
-    xg = xq.reshape(m, n_g, gsz)
-    xa = jnp.transpose(xg[:, :, :half], (1, 0, 2))  # [n_g, m, half]
-    xb = jnp.transpose(xg[:, :, half:], (1, 0, 2))
+    # group-major activation layout for the kernel: [n_g, m, gsz] — group
+    # g's rows in natural order, matching the stacked [lo; hi] weight
+    # operand the kernel assembles per group
+    xg = jnp.transpose(xq.reshape(m, n_g, gsz), (1, 0, 2))
     m_padded = -(-m // 8) * 8
     mt = m_padded
     if m_padded != m:
-        xa = jnp.pad(xa, ((0, 0), (0, m_padded - m), (0, 0)))
-        xb = jnp.pad(xb, ((0, 0), (0, m_padded - m), (0, 0)))
+        xg = jnp.pad(xg, ((0, 0), (0, m_padded - m), (0, 0)))
 
     it, ot, n_gt, out_map, q_map, s_map, q_block, s_block, scalars = \
         _tiles_and_maps(in_dim, out, gsz, n_g, layered, layer, wide_ot=True)
@@ -277,13 +282,15 @@ def _w4a8_matmul(x, q, s, zs, layer, out_dtype, interpret: bool):
         num_scalar_prefetch=len(scalars),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((n_gt, mt, half), x_map),
-            pl.BlockSpec((n_gt, mt, half), x_map),
+            pl.BlockSpec((n_gt, mt, gsz), x_map),
             pl.BlockSpec(q_block, q_map),
             pl.BlockSpec(s_block, s_map),
         ],
         out_specs=pl.BlockSpec((mt, ot), out_map),
-        scratch_shapes=[pltpu.VMEM((mt, ot), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((mt, ot), jnp.float32),
+            pltpu.VMEM((gsz, ot), jnp.int8),  # per-group stacked [lo; hi]
+        ],
     )
     kernel = functools.partial(
         _w4a8_kernel, half=half, n_gt=n_gt, layered=layered,
@@ -297,7 +304,7 @@ def _w4a8_matmul(x, q, s, zs, layer, out_dtype, interpret: bool):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(*scalars, xa, xb, q, s)
+    )(*scalars, xg, q, s)
     y = sxn * (acc[:m] - zs_term)
     return y.astype(out_dtype).reshape(*lead, out)
 
@@ -331,6 +338,17 @@ def int4_matmul(
         # is MXU-compute-bound there, and the f32 [m, out] partial would
         # be large), so prompt processing keeps the stricter contract
         w4a8 = m <= 256 and _w4a8_enabled()
+    if w4a8 and not interpret:
+        # the kernel's stacked [lo; hi] scratch stores slice the int8
+        # sublane axis at offset gsz/2, which Mosaic only legalizes at
+        # 32-row multiples — serving group sizes (64 default, AWQ 128)
+        # qualify; anything smaller routes to the exact bf16-dequant
+        # kernel instead of failing to compile (interpret mode has no
+        # such constraint, so CPU tests still exercise the W4A8 math at
+        # tiny group sizes)
+        n_g_chk = s.shape[-2]
+        if (x.shape[-1] // n_g_chk) // 2 % 32:
+            w4a8 = False
     if w4a8:
         return _w4a8_matmul(x, q, s, zs, layer, out_dtype, interpret)
     layered = q.ndim == 3

@@ -147,7 +147,16 @@ def sample_tokens_nofilter(
     candidate pull and no sort.  The candidate sort costs ~0.23 ms per
     decode step at bs8 on v5e (device trace: ``sort.9``), and grows with
     the row count; the engine selects this variant per burst from its
-    host-side sampling mirrors (serving/engine.py _decode_step)."""
+    host-side sampling mirrors (serving/engine.py _decode_step).
+
+    Distribution contract: the engine's sampling support is "the top-cap
+    candidates" (sample_tokens_capped); this variant WIDENS that to the
+    exact full vocab when the whole batch qualifies.  A non-filtering row
+    batched with a filtering one therefore samples from the top-cap
+    support instead — the delta is the tail mass beyond the top 128
+    logits, negligible at practical temperatures, and batch composition
+    already shifts per-row draws (rows index a shared step key), so no
+    cross-composition reproducibility is lost that ever existed."""
     logits = apply_repetition_penalty(logits, presence, repetition_penalty[:, None])
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]

@@ -1186,9 +1186,12 @@ class Engine:
                 self.generate([[tok] * plen] + [[tok] * 3] * (nb - 1), sp)
         # both burst sampling variants must be warm: the bucket loop above
         # compiled the no-filter (Gumbel-argmax) burst; one filtered request
-        # compiles the sample_tokens_capped burst
+        # compiles the sample_tokens_capped burst (in-vocab tokens — tiny
+        # test configs have single-digit vocabs)
+        wave += 1
+        tok = 2 + wave % max(2, self.cfg.vocab_size - 2)
         self.generate(
-            [[9, 8, 7]],
+            [[tok] * 3],
             SamplingParams(max_tokens=2, temperature=0.7, top_p=0.9,
                            stop_token_ids=()),
         )
