@@ -404,8 +404,9 @@ def bench_7b(bits: int, keep_params: bool = False):
 
     cfg = Qwen2Config.qwen2_7b()
     tag = f"qwen2-7b-int{bits}"
-    log(f"bench[{tag}]: building host-side int{bits} params "
-        f"(transfer ~{2 if bits == 4 else 4} min through the tunnel)")
+    log(f"bench[{tag}]: generating int{bits} params ON DEVICE "
+        "(quant._devrand — no host build, no tunnel transfer; the "
+        "host-side path cost ~20 min on a slow tunnel day)")
     params = init_params_quantized(cfg, bits=bits, fuse=True)
     jax.block_until_ready(params)
     log(f"bench[{tag}]: {params_nbytes(params) / 1e9:.2f} GB on chip; compiling")
@@ -477,7 +478,7 @@ def _main() -> None:
     # first ("release every earlier model's params first" — observed
     # RESOURCE_EXHAUSTED otherwise) and re-inits lazily afterwards.
     run_7b = os.environ.get("BENCH_7B", "1") != "0"
-    if run_7b and budget_allows("qwen2-7b-int8", 700):
+    if run_7b and budget_allows("qwen2-7b-int8", 420):
         params05 = None  # rebind frees the device tree
         gc.collect()
         tps7, nbytes7, params7, cfg7 = bench_7b(bits=8, keep_params=True)
@@ -612,7 +613,7 @@ def _main() -> None:
     gc.collect()
 
     # ---- Qwen2-7B int4 (the reference's AWQ scheme; Pallas dequant GEMM) --
-    if run_7b and budget_allows("qwen2-7b-int4", 300):
+    if run_7b and budget_allows("qwen2-7b-int4", 200):
         params05 = None  # rebind frees the device tree (if still resident)
         gc.collect()
         tps7i4, nbytes7i4 = bench_7b(bits=4)

@@ -24,6 +24,7 @@ stay bf16.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -437,16 +438,50 @@ def quantize_qwen2_params(
     return out
 
 
+@functools.partial(jax.jit, static_argnames=("shape", "kind"))
+def _devrand(shape: tuple, salt: jnp.ndarray, kind: str) -> jnp.ndarray:
+    """Uniform-ish random leaf ON DEVICE via a Knuth-hashed iota — a pure
+    elementwise chain XLA fuses straight into the FINAL dtype, so a
+    multi-GB random leaf costs one device-side write (in the narrow output
+    type — the u32 intermediate must stay inside this jit or a 7B-scale
+    leaf transiently materializes 4x its bytes and OOMs the chip) and ZERO
+    host->device transfer.  The host-numpy path this replaces cost the
+    bench ~20 min of tunnel transfer for the 7B int8 tree (and minutes of
+    single-thread RNG); bench throughput is weight-value-independent, so
+    hash quality only needs to defeat trivial value patterns.
+
+    kinds: "u8" uniform uint8; "i8" uniform int8 (bitcast); "bf16"
+    centered floats with std ~ 0.02."""
+    n = 1
+    for s_ in shape:
+        n *= s_
+    i = jax.lax.iota(jnp.uint32, n)
+    h = i * jnp.uint32(2654435761) + salt
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(2246822519)
+    h = (h ^ (h >> 13)).reshape(shape)
+    if kind == "u8":
+        return (h & jnp.uint32(0xFF)).astype(jnp.uint8)
+    if kind == "i8":
+        return jax.lax.bitcast_convert_type(
+            (h & jnp.uint32(0xFF)).astype(jnp.uint8), jnp.int8
+        )
+    assert kind == "bf16", kind
+    # uniform [0, 2^32) -> centered, std ~ 0.02 (uniform std = range/sqrt(12))
+    return ((h.astype(jnp.float32) - 2147483648.0) * (0.02 / 1.24e9)).astype(
+        jnp.bfloat16
+    )
+
+
 def init_params_quantized(cfg, seed: int = 0, bits: int = 8,
                           group_size: int = 64, fuse: bool = False) -> dict:
-    """Random quantized Qwen2 params (int8 or AWQ-class int4), built
-    HOST-side leaf by leaf (a 7B bf16 tree cannot be materialized on a
-    16 GB chip just to quantize it; real checkpoints stream through
-    quantize_weight/quantize_weight4 shard by shard in hf_loader).
-    Bench/test use: throughput is weight-value-independent."""
-    import ml_dtypes
-    import numpy as np
-
+    """Random quantized Qwen2 params (int8 or AWQ-class int4), generated
+    leaf by leaf ON DEVICE (_devrand): a 7B bf16 tree cannot be
+    materialized on a 16 GB chip just to quantize it, and building the
+    quantized tree host-side costs the bench ~20 min of remote-TPU tunnel
+    transfer.  Real checkpoints stream through quantize_weight /
+    quantize_weight4 shard by shard in hf_loader.  Bench/test use:
+    throughput is weight-value-independent."""
     if getattr(cfg, "num_experts", 0):
         raise NotImplementedError(
             "random quantized MoE init is not implemented (this helper exists "
@@ -455,19 +490,22 @@ def init_params_quantized(cfg, seed: int = 0, bits: int = 8,
         )
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
-    rng = np.random.default_rng(seed)
+    salt_box = [jnp.uint32(seed * 40503 + 12345)]
+
+    def noise(shape, kind):
+        salt_box[0] = salt_box[0] * jnp.uint32(747796405) + jnp.uint32(1)
+        return _devrand(tuple(shape), salt_box[0], kind)
+
     d, nq, nkv, hd, inter, L, v = (
         cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
         cfg.intermediate_size, cfg.num_layers, cfg.vocab_size,
     )
 
     def bf16(*shape):
-        return jnp.asarray(
-            (rng.standard_normal(shape) * 0.02).astype(ml_dtypes.bfloat16)
-        )
+        return noise(shape, "bf16")
 
     def qlin8(*shape):
-        q = jnp.asarray(rng.integers(-127, 128, shape, dtype=np.int8))
+        q = noise(shape, "i8")
         # scale so dequantized std ~ 0.02 (uniform int8 std ~ 73)
         s = jnp.full(shape[:-2] + shape[-1:], 0.02 / 73.0, dtype=jnp.bfloat16)
         return QuantizedLinear(q=q, s=s)
@@ -479,9 +517,7 @@ def init_params_quantized(cfg, seed: int = 0, bits: int = 8,
                 f"input dim {in_dim} must be divisible by the (even) "
                 f"group_size {group_size} (same contract as quantize_weight4)"
             )
-        packed = jnp.asarray(
-            rng.integers(0, 256, shape[:-2] + (in_dim // 2, out), dtype=np.uint8)
-        )
+        packed = noise(shape[:-2] + (in_dim // 2, out), "u8")
         sshape = shape[:-2] + (in_dim // group_size, out)
         # uniform uint4 std ~ 4.6; center with zs = 7.5*s
         s = jnp.full(sshape, 0.02 / 4.6, dtype=jnp.bfloat16)
@@ -516,7 +552,7 @@ def init_params_quantized(cfg, seed: int = 0, bits: int = 8,
             "wg": qlin(L, d, inter),
             "wu": qlin(L, d, inter),
         })
-    embed_q = jnp.asarray(rng.integers(-127, 128, (v, d), dtype=np.int8))
+    embed_q = noise((v, d), "i8")
     embed_s = jnp.full((v,), 0.02 / 73.0, dtype=jnp.bfloat16)
     params = {"embed": QuantizedEmbedding(q=embed_q, s=embed_s), "layers": layers,
               "norm": jnp.ones((d,), dtype=jnp.bfloat16)}

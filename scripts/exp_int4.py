@@ -1,6 +1,7 @@
 """7B int4 (W4A8) decode throughput check — iterates on the Pallas kernel
-without paying the full bench. Builds the int4 tree host-side, transfers
-(~2 min), runs the bs32 decode geometry from bench.py's int4 item."""
+without paying the full bench. Generates the int4 tree on device
+(quant._devrand — no host build or tunnel transfer), then runs the bs32
+decode geometry from bench.py's int4 item."""
 
 import sys
 import time
@@ -24,7 +25,7 @@ from githubrepostorag_tpu.models.quant import init_params_quantized, params_nbyt
 params = init_params_quantized(cfg, bits=4, fuse=True)
 jax.block_until_ready(params)
 nbytes = params_nbytes(params)
-print(f"int4 tree {nbytes / 1e9:.2f} GB built+transferred in "
+print(f"int4 tree {nbytes / 1e9:.2f} GB generated on device in "
       f"{time.monotonic() - t0:.0f}s", flush=True)
 
 rng = np.random.default_rng(0)
