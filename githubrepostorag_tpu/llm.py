@@ -149,6 +149,25 @@ class InProcessLLM:
             self._loop_ready.wait()
         return self._loop
 
+    def close(self) -> None:
+        """Stop the engine driver and the background asyncio loop.  Without
+        this, short-lived instances (bench items, tests) leak a daemon
+        drive thread whose closure keeps the Engine — and its device page
+        pools — alive past ``del``."""
+        if self._loop is None:
+            return
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.engine.stop(), self._loop
+            ).result(timeout=10)
+        except Exception:  # noqa: BLE001 - best-effort shutdown
+            logger.warning("InProcessLLM.close: engine stop failed", exc_info=True)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+        self._loop = None
+        self._loop_thread = None
+
     def _messages(self, prompt: str, system: str | None) -> list[dict]:
         messages = []
         if system:

@@ -74,6 +74,9 @@ def build_table(records: list[dict]) -> str:
             ["concurrent64_agg_tok_s_qwen2-0.5b_kvquant_int8"], "tok/s", vs, extras),
         row("1k-doc extractor batch (0.5B)", summary,
             ["extractor_batch1k_docs_s_qwen2-0.5b"], "docs/s", vs, extras),
+        row("Full agent loop e2e, p50 / LLM calls per query (0.5B)", summary,
+            ["rag_e2e_3round_p50_s_qwen2-0.5b",
+             "rag_e2e_llm_calls_per_query"], "", vs, extras),
         row("Embedding (e5-small geometry)", summary,
             ["embed_chunks_s_e5-small"], "chunks/s", vs, extras),
     ]
