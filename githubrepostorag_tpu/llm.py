@@ -165,8 +165,13 @@ class InProcessLLM:
         self._loop.call_soon_threadsafe(self._loop.stop)
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=10)
+        self._loop.close()  # release the selector fd, not just the reference
         self._loop = None
         self._loop_thread = None
+        # a later call may start a fresh loop (AsyncEngine supports
+        # stop() -> start() relaunch); the ready Event must re-arm or
+        # _ensure_loop would return before the new thread assigns _loop
+        self._loop_ready.clear()
 
     def _messages(self, prompt: str, system: str | None) -> list[dict]:
         messages = []

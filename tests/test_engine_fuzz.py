@@ -47,10 +47,13 @@ CONFIGS = [
     dict(prefix_caching=False),
     dict(spec_ngram_k=3),
     dict(decode_burst=1),  # per-token stepping
+    dict(prefill_widths=3),  # width-bucketed prefill dispatches
 ]
 
 
-@pytest.mark.parametrize("extra", CONFIGS, ids=["default", "nocache", "spec", "burst1"])
+@pytest.mark.parametrize(
+    "extra", CONFIGS, ids=["default", "nocache", "spec", "burst1", "widths"]
+)
 def test_random_schedule_episode(tiny, extra):
     params, cfg = tiny
     import zlib
@@ -129,7 +132,13 @@ def test_random_schedule_episode(tiny, extra):
     assert eng._chain is None and not eng._pending_first and not eng._deferred
 
 
-def test_random_schedule_sampled_invariants(tiny):
+@pytest.mark.parametrize("extra", [
+    dict(spec_ngram_k=3),  # speculative path
+    dict(prefill_widths=3),  # plain bursts: the mixed top_p traffic flips
+    # the filter_sampling burst variant between bursts, over width-bucketed
+    # prefill dispatches
+], ids=["spec", "burst-widths"])
+def test_random_schedule_sampled_invariants(tiny, extra):
     """Sampled traffic (temperature > 0, top-p, penalties) under random
     scheduling: outputs are seed-dependent, so only the structural
     invariants are asserted — everything finishes, lengths are sane, and
@@ -138,7 +147,7 @@ def test_random_schedule_sampled_invariants(tiny):
     rng = np.random.default_rng(99)
     eng = Engine(params, cfg, max_num_seqs=4, num_pages=48, page_size=8,
                  max_seq_len=128, prefill_chunk=16, kv_dtype=jnp.float32,
-                 decode_burst=4, spec_ngram_k=3)
+                 decode_burst=4, **extra)
     want: dict[str, int] = {}
     done: dict[str, object] = {}
     steps = 0
