@@ -799,6 +799,31 @@ def _main() -> None:
         rate = bench_embedding(chunks=4096, seq_len=256, batch=256)
         emit("embed_chunks_s_e5-small", rate, "chunks/s", None)
 
+    # ---- MoE family decode (beyond-reference component, measured) --------
+    # The Qwen2-MoE family (models/moe.py: GShard dispatch/combine, shared
+    # expert, ep-shardable) had parity tests but no perf line.  The real
+    # A2.7B geometry (14.3B params) cannot fit one 16 GB chip in bf16, so
+    # this measures a mid-scale 16-expert top-2 geometry (~2.3 GB): GShard's
+    # dense one-hot combine streams EVERY expert per step, so the roofline
+    # is the full tree — same accounting as the dense rows.
+    if budget_allows("moe-decode", 150):
+        cfg_moe = Qwen2Config(
+            vocab_size=151936, hidden_size=1024, intermediate_size=2816,
+            num_layers=12, num_heads=16, num_kv_heads=4, head_dim=64,
+            tie_word_embeddings=True, max_position_embeddings=4096,
+            num_experts=16, num_experts_per_tok=2, moe_intermediate_size=1408,
+            shared_expert_intermediate_size=2816, norm_topk_prob=True,
+        )
+        tps_moe, _, params_moe = bench_decode(
+            cfg_moe, "qwen2-moe-16e", batch=8, prompt_len=128, gen_tokens=256,
+            num_pages=64, page_size=256, max_seq=1024, decode_burst=128,
+            runs=2)
+        nbytes_moe = streamed_nbytes(params_moe)
+        emit("decode_tok_s_per_chip_qwen2-moe-16e_bs8", tps_moe, "tok/s",
+             tps_moe / BASELINE_TOK_S, **decode_extras(tps_moe, 8, nbytes_moe))
+        del params_moe
+        gc.collect()
+
 
 
 if __name__ == "__main__":

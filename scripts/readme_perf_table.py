@@ -79,6 +79,8 @@ def build_table(records: list[dict]) -> str:
              "rag_e2e_llm_calls_per_query"], "", vs, extras),
         row("Embedding (e5-small geometry)", summary,
             ["embed_chunks_s_e5-small"], "chunks/s", vs, extras),
+        row("Qwen2-MoE 16-expert decode, bs=8 (beyond-reference)", summary,
+            ["decode_tok_s_per_chip_qwen2-moe-16e_bs8"], "tok/s", vs, extras),
     ]
     head = ("<!-- PERF_TABLE_START (generated: python "
             "scripts/readme_perf_table.py — do not hand-edit rows) -->\n"
