@@ -490,28 +490,19 @@ def forward_paged_impl(
         p = _with_layered_q4(p, q4_stacks, li, kernel=int4_kernel, w4a8=False)
 
         def attend(q, k, v):
-            # [n_kv, P*ps, hd] flat view; one slot vector shared by all heads
-            kp_flat = kp.reshape(nkv, total_slots, hd)
-            vp_flat = vp.reshape(nkv, total_slots, hd)
+            from githubrepostorag_tpu.serving.kv_cache import commit_paged
+
             k_t = k.reshape(-1, nkv, hd).swapaxes(0, 1)  # [n_kv, B*S, hd]
             v_t = v.reshape(-1, nkv, hd).swapaxes(0, 1)
-            if quant:
-                from githubrepostorag_tpu.serving.kv_cache import (
-                    quantize_kv_paged,
-                )
-
-                # per-page scales [n_kv, P]: first write fixes a page's
-                # scale, appends reuse it (kv_cache.quantize_kv_paged)
-                k_t, new_ks = quantize_kv_paged(k_t, flat_slots, ks, page_size)
-                v_t, new_vs = quantize_kv_paged(v_t, flat_slots, vs, page_size)
-            else:
-                k_t = k_t.astype(kp.dtype)
-                v_t = v_t.astype(vp.dtype)
-                new_ks = new_vs = None
-            kp_flat = kp_flat.at[:, flat_slots].set(k_t, mode="drop")
-            vp_flat = vp_flat.at[:, flat_slots].set(v_t, mode="drop")
-            new_kp = kp_flat.reshape(nkv, num_pages, page_size, hd)
-            new_vp = vp_flat.reshape(nkv, num_pages, page_size, hd)
+            # commit_paged is THE shared pool-commit rule (cast for bf16
+            # pools; per-page first-write scales for int8 — same semantics
+            # as the burst and ring-prefill commits)
+            new_kp, new_ks = commit_paged(
+                kp, k_t, flat_slots, ks if quant else None, page_size
+            )
+            new_vp, new_vs = commit_paged(
+                vp, v_t, flat_slots, vs if quant else None, page_size
+            )
             if quant:
                 attn = attn_fn(q, new_kp, new_vp, block_tables, cached_lens,
                                new_lens, new_ks, new_vs)

@@ -310,20 +310,14 @@ def decode_burst(
     slots = jnp.where(valid, slots, total_slots)  # sentinel -> mode="drop"
     flat_slots = slots.reshape(-1)  # [B*n_steps]
 
-    def commit(pools, staged, scales=None):
-        flat = pools.reshape(L, n_kv, total_slots, hd)
-        # [L, B, n_kv, n, hd] -> [L, n_kv, B*n, hd] matching flat_slots order
-        vals = staged.swapaxes(1, 2).reshape(L, n_kv, b * n_steps, hd)
-        if scales is None:
-            flat = flat.at[:, :, flat_slots].set(vals, mode="drop")
-            return flat.reshape(pools.shape), None
-        from githubrepostorag_tpu.serving.kv_cache import quantize_kv_paged
+    from githubrepostorag_tpu.serving.kv_cache import commit_paged
 
-        # per-page scales [L, n_kv, P]: first write to a page fixes its
-        # scale, appends reuse it (kv_cache.quantize_kv_paged)
-        q, scales = quantize_kv_paged(vals, flat_slots, scales, page_size)
-        flat = flat.at[:, :, flat_slots].set(q, mode="drop")
-        return flat.reshape(pools.shape), scales
+    def commit(pools, staged, scales=None):
+        # [L, B, n_kv, n, hd] -> [L, n_kv, B*n, hd] matching flat_slots
+        # order; commit_paged is THE shared pool-commit rule (per-page
+        # first-write scales when quantized)
+        vals = staged.swapaxes(1, 2).reshape(L, n_kv, b * n_steps, hd)
+        return commit_paged(pools, vals, flat_slots, scales, page_size)
 
     k_pages, k_scales = commit(k_pages, staged_k, k_scales)
     v_pages, v_scales = commit(v_pages, staged_v, v_scales)

@@ -197,12 +197,6 @@ class Engine:
         # opaque pallas_call has no GSPMD partitioning rule); TP meshes
         # take the partitionable XLA formulation (quant.Layered4XLA)
         self._int4_kernel = mesh is None or mesh.shape.get("tp", 1) == 1
-        if kv_quant and sp_prefill_threshold:
-            raise NotImplementedError(
-                "kv_quant + sp ring prefill: the ring commit writes "
-                "full-precision pages (serving/long_prefill.py); quantize "
-                "there before combining the two"
-            )
         pools = make_page_pools(cfg, num_pages, page_size, dtype=kv_dtype,
                                 quant=kv_quant)
         self._k_pages, self._v_pages = pools.k, pools.v
@@ -230,7 +224,7 @@ class Engine:
         self.sp_prefills = 0  # stats: prompts served by the ring-prefill path
         self.spec_ngram_k = spec_ngram_k
         if spec_burst_iters > 0 and spec_ngram_k <= 0:
-            # fail fast on the inert combo (same policy as kv_quant+sp):
+            # fail fast on the inert combo:
             # the fused burst only engages inside the spec_ngram_k gate
             raise ValueError(
                 "spec_burst_iters requires spec_ngram_k > 0 "
@@ -686,12 +680,14 @@ class Engine:
             self._block_tables[req.row], 0, n, self.page_size, width
         )[None]
         with annotate("engine.sp_prefill"):
-            logits, self._k_pages, self._v_pages = ring_prefill(
+            (logits, self._k_pages, self._v_pages,
+             self._k_scales, self._v_scales) = ring_prefill(
                 self.params, self.cfg,
                 jnp.asarray(ids), jnp.asarray(pos),
                 self._k_pages, self._v_pages,
                 jnp.asarray(slots), jnp.asarray([n - 1], dtype=jnp.int32),
                 self.mesh,
+                k_scales=self._k_scales, v_scales=self._v_scales,
             )
         self.sp_prefills += 1
         req.prefill_pos = req.seq_len = n

@@ -131,6 +131,32 @@ def quantize_kv_paged(
     return q, upd[..., :p]
 
 
+def commit_paged(
+    pools: jnp.ndarray,  # [..., P, page_size, hd]
+    vals: jnp.ndarray,  # [..., N, hd] new K or V vectors, leading dims match
+    flat_slots: jnp.ndarray,  # [N] int32 flat slots; out-of-range = dropped
+    scales: jnp.ndarray | None,  # [..., P] f32 per-page (int8 pools) or None
+    page_size: int,
+):
+    """Scatter new K or V vectors into flat pool slots — THE pool-commit
+    rule, shared by the chunked-prefill (models/qwen2.forward_paged),
+    decode-burst (serving/decode_burst), and ring-prefill
+    (serving/long_prefill) paths so the quantization/scatter semantics can
+    never drift apart.  ``scales is None`` = full-precision pools (vals
+    cast to the pool dtype); else int8 pools with each page's scale fixed
+    by its first write (quantize_kv_paged).  Returns (pools, scales)."""
+    p, ps, hd = pools.shape[-3:]
+    if scales is None:
+        vals = vals.astype(pools.dtype)
+    else:
+        vals, scales = quantize_kv_paged(vals, flat_slots, scales, page_size)
+    flat = pools.reshape(-1, p * ps, hd)
+    flat = flat.at[:, flat_slots].set(
+        vals.reshape(-1, vals.shape[-2], hd), mode="drop"
+    )
+    return flat.reshape(pools.shape), scales
+
+
 class OutOfPages(RuntimeError):
     """Raised when the pool can't back a new allocation; the scheduler
     responds by queueing (or preempting) instead of corrupting the cache."""

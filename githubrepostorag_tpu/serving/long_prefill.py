@@ -47,18 +47,23 @@ def ring_prefill(
     slot_mapping: jnp.ndarray,  # [1, Sp] int32 flat pool slots, -1 padding
     last_idx: jnp.ndarray,  # [1] int32 — index of the last real token
     mesh,  # jax.sharding.Mesh with sp > 1 (tp composes; heads shard when divisible)
+    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P] f32 — int8 pools'
+    v_scales: jnp.ndarray | None = None,  # per-page scales (kv_quant)
 ):
     """Prefill an entire prompt sequence-parallel and write its KV pages.
 
-    Returns (logits [1, 1, V] float32, k_pages, v_pages).  Padding tokens
-    sit AFTER the last real token, so causal masking keeps them out of every
-    real position's attention, and their K/V carry slot -1 (dropped by the
+    Returns (logits [1, 1, V] float32, k_pages, v_pages, k_scales,
+    v_scales); the scales are None unless the pools are int8 (kv_quant),
+    in which case the commit quantizes each page with the same
+    first-write-fixes-the-scale rule as the chunked/burst paths
+    (serving/kv_cache.commit_paged).  Padding tokens sit AFTER the
+    last real token, so causal masking keeps them out of every real
+    position's attention, and their K/V carry slot -1 (dropped by the
     scatter).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    nkv, hd = cfg.num_kv_heads, cfg.head_dim
-    L = cfg.num_layers
+    hd = cfg.head_dim
     num_pages, page_size = k_pages.shape[2], k_pages.shape[3]
     total_slots = num_pages * page_size
 
@@ -92,11 +97,16 @@ def ring_prefill(
     # range so mode="drop" discards them
     flat_slots = jnp.where(flat_slots < 0, total_slots, flat_slots)
 
-    def commit(pools, stacked):
+    from githubrepostorag_tpu.serving.kv_cache import commit_paged
+
+    def commit(pools, stacked, scales):
         # stacked [L, 1, Sp, n_kv, hd] -> [L, n_kv, Sp, hd] matching the
         # flat [L, n_kv, P*ps, hd] pool view
-        flat = pools.reshape(L, nkv, total_slots, hd)
-        vals = stacked[:, 0].transpose(0, 2, 1, 3).astype(pools.dtype)
-        return flat.at[:, :, flat_slots].set(vals, mode="drop").reshape(pools.shape)
+        vals = stacked[:, 0].transpose(0, 2, 1, 3)
+        return commit_paged(pools, vals, flat_slots, scales, page_size)
 
-    return logits, commit(k_pages, ks), commit(v_pages, vs)
+    k_pages, k_scales = commit(k_pages, ks, k_scales)
+    v_pages, v_scales = commit(v_pages, vs, v_scales)
+    # fixed arity: scales are None for full-precision pools — callers
+    # unpack five values unconditionally
+    return logits, k_pages, v_pages, k_scales, v_scales

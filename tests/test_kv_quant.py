@@ -108,13 +108,20 @@ def test_kv_quant_spec_decode_runs(tiny, burst_iters):
     assert eng.spec_accepted > 0  # the repeating tail drafted + accepted
 
 
-def test_kv_quant_rejects_sp_ring_prefill(tiny):
+def test_kv_quant_composes_with_sp_ring_prefill(tiny):
+    """Round-4: the ring commit quantizes per page (long_prefill.py), so
+    kv_quant + sp no longer rejects at construction — a long prompt rides
+    the ring path onto int8 pools and decodes.  Cross-path token parity
+    lives in tests/test_long_prefill.py."""
     cfg, params = tiny
     from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
 
-    with pytest.raises(NotImplementedError, match="ring prefill"):
-        _engine(params, cfg, kv_quant=True, mesh=make_mesh(MeshPlan(sp=2)),
-                sp_prefill_threshold=32)
+    eng = _engine(params, cfg, kv_quant=True, mesh=make_mesh(MeshPlan(sp=2)),
+                  sp_prefill_threshold=32)
+    sp = SamplingParams(max_tokens=6, temperature=0.0, stop_token_ids=())
+    res = eng.generate([list(range(1, 41))], sp)[0]  # 40 >= threshold
+    assert eng.sp_prefills == 1
+    assert len(res.output_tokens) == 6
 
 
 def test_staged_kernel_int8_matches_dequant_reference(tiny):

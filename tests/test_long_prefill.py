@@ -94,6 +94,37 @@ def test_ring_prefill_pool_contents_match_chunked(tiny):
     np.testing.assert_allclose(v_a, v_b, rtol=1e-5, atol=1e-5)
 
 
+def test_ring_prefill_kv_quant_matches_chunked(tiny):
+    """kv_quant composes with the ring path: the ring commit quantizes per
+    page with the SAME first-write-fixes-the-scale rule as the chunked
+    path (serving/kv_cache.quantize_kv_paged).  In this geometry every
+    prefill chunk covers whole pages, so both paths fix identical scales
+    — decoded tokens must match exactly, and the int8 page bytes within a
+    quantization step: the paths are NOT bit-identical, because the
+    chunked path's later chunks attend over already-quantized earlier
+    pages (its K/V inherit that rounding) while the ring path computes
+    the whole prompt full-precision before one quantized commit."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, cfg.vocab_size, size=48).tolist()
+    sp = SamplingParams(max_tokens=12, temperature=0.0, stop_token_ids=())
+
+    eng_a = _engine(params, cfg, kv_quant=True)
+    eng_b = _sp_engine(params, cfg, kv_quant=True)
+    expected = eng_a.generate([prompt], sp)[0].output_tokens
+    got = eng_b.generate([prompt], sp)[0].output_tokens
+    assert eng_b.sp_prefills == 1, "prompt above threshold must ride the sp path"
+    assert got == expected
+    for a, b in ((eng_a._k_pages, eng_b._k_pages),
+                 (eng_a._v_pages, eng_b._v_pages)):
+        diff = np.abs(np.asarray(a, np.int32) - np.asarray(b, np.int32))
+        assert diff.max() <= 2, f"pages diverged beyond rounding: {diff.max()}"
+    np.testing.assert_allclose(
+        np.asarray(eng_a._k_scales), np.asarray(eng_b._k_scales),
+        rtol=2e-2, atol=1e-7,
+    )
+
+
 def test_short_prompts_stay_on_chunked_path(tiny):
     _, params, cfg = tiny
     prompt = list(range(1, 21))  # 20 tokens < threshold 40
