@@ -222,24 +222,72 @@ def bench_decode(cfg, tag: str, *, batch: int, prompt_len: int, gen_tokens: int,
     return tps, ttft, params
 
 
+def _timed_generate(engine, prompts, sp):
+    """engine.generate through the public step loop with per-step timing, so
+    a bad concurrency run explains itself (VERDICT r04 weak #1: the driver
+    saw 299 tok/s where the builder saw 2374 — an 8x swing a bare wall-clock
+    number can't attribute).  A step taken while any row is admitting counts
+    toward the prompt wave; the rest is decode.  ``max_step_s`` exposes a
+    mid-run stall (an uncached XLA compile through the tunnel costs tens of
+    seconds; a healthy 7B step is ~30 ms)."""
+    order = [engine.add_request(p, sp) for p in prompts]
+    done: dict = {}
+    prompt_wave = decode_wall = max_step = 0.0
+    n_steps = 0
+    t0 = time.monotonic()
+    while engine.has_work():
+        admitting = engine.is_admitting
+        ts = time.monotonic()
+        for res in engine.step():
+            done[res.request_id] = res
+        dt = time.monotonic() - ts
+        n_steps += 1
+        max_step = max(max_step, dt)
+        if admitting:
+            prompt_wave += dt
+        else:
+            decode_wall += dt
+    wall = time.monotonic() - t0
+    phases = {"wall_s": round(wall, 3), "n_steps": n_steps,
+              "max_step_s": round(max_step, 3),
+              "prompt_wave_s": round(prompt_wave, 3),
+              "decode_wall_s": round(decode_wall, 3)}
+    return [done[rid] for rid in order], phases
+
+
 def bench_concurrency(cfg, *, streams: int, prompt_len: int, gen_tokens: int,
-                      engine) -> tuple[float, float]:
+                      engine, trials: int = 1,
+                      seed0: int = 1) -> tuple[float, float, dict]:
     """Eval config #5 shape: many concurrent streams through continuous
-    batching; p50 TTFT includes queue wait."""
+    batching; p50 TTFT includes queue wait.  ``trials`` > 1 reruns the whole
+    wave with FRESH prompts (prefix caching would serve repeated prompts
+    from cache) and keeps the MEDIAN-throughput trial — one tunnel hiccup or
+    stray compile in a ~3 s run otherwise swings the aggregate 8x
+    (VERDICT r04 next-round #1)."""
     from githubrepostorag_tpu.serving.sampling_params import SamplingParams
 
-    prompts = _prompts(streams, prompt_len, cfg.vocab_size, seed=1)
     sp = SamplingParams(max_tokens=gen_tokens, temperature=0.7, stop_token_ids=())
-    t0 = time.monotonic()
-    results = engine.generate(prompts, sp)
-    wall = time.monotonic() - t0
-    toks = sum(len(r.output_tokens) for r in results)
-    ttfts = sorted(r.ttft_s for r in results if r.ttft_s is not None)
-    p50 = ttfts[len(ttfts) // 2]
-    agg = toks / wall
-    log(f"bench[concurrency]: {streams} streams, {toks} toks in {wall:.2f}s "
-        f"-> {agg:.1f} tok/s aggregate, p50 TTFT {p50:.3f}s")
-    return agg, p50
+    outcomes = []  # (agg, p50, phases)
+    for t in range(trials):
+        prompts = _prompts(streams, prompt_len, cfg.vocab_size, seed=seed0 + t)
+        results, phases = _timed_generate(engine, prompts, sp)
+        toks = sum(len(r.output_tokens) for r in results)
+        ttfts = sorted(r.ttft_s for r in results if r.ttft_s is not None)
+        p50 = ttfts[len(ttfts) // 2]
+        agg = toks / phases["wall_s"]
+        outcomes.append((agg, p50, phases))
+        stall = " STALL" if phases["max_step_s"] > 2.0 else ""
+        log(f"bench[concurrency]: trial {t}: {streams} streams, {toks} toks "
+            f"in {phases['wall_s']:.2f}s -> {agg:.1f} tok/s agg, p50 TTFT "
+            f"{p50:.3f}s | wave {phases['prompt_wave_s']:.2f}s decode "
+            f"{phases['decode_wall_s']:.2f}s steps {phases['n_steps']} "
+            f"max_step {phases['max_step_s']:.3f}s{stall}")
+    outcomes.sort(key=lambda o: o[0])
+    # median-agg trial; for an even count take the LOWER middle — a bench
+    # honesty suite must not report best-of-two as "the median"
+    agg, p50, phases = outcomes[(len(outcomes) - 1) // 2]
+    phases = dict(phases, trial_aggs=[round(o[0], 1) for o in outcomes])
+    return agg, p50, phases
 
 
 def bench_extractor_batch(cfg, *, docs: int, prompt_len: int,
@@ -364,6 +412,85 @@ def bench_spec_decode(params_in, cfg) -> tuple[float, float, float, float, float
         f"burst {sburst_wall:.2f}s at bs=1 (fused acceptance "
         f"{sb_acc / max(sb_prop, 1):.2f})")
     return toks_per_dispatch, acceptance, spec_wall, burst_wall, sburst_wall
+
+
+def bench_spec_decode_rag(cfg0) -> dict:
+    """Speculative decoding on a RAG-SHAPED quoting workload (VERDICT r04
+    next #5: the zero-layer construction above measures acceptance 1.0 on a
+    pure-repeat tail, which predicts nothing about answers that QUOTE
+    context chunks and diverge between quotes).
+
+    Construction — honest acceptance in (0,1) at full dense matmul cost:
+    zero layers leave the residual stream carrying embed[t]; an UNTIED
+    lm_head whose column o is embed row o-1 makes greedy argmax map t ->
+    t+1, so the model deterministically narrates the token cycle.  The
+    prompt lays CONSECUTIVE cycle segments in shuffled order (the "context
+    chunks"); the answer walks the cycle, so the bigram prompt-lookup
+    drafter re-locks onto each chunk, accepts inside a chunk's span, and
+    mispredicts exactly at chunk boundaries (the earliest occurrence of a
+    chunk's last token is followed in the prompt by a DIFFERENT chunk) —
+    the accept/reject profile of a quoting RAG answer under vLLM-style
+    prompt lookup.  Span 32 / draft k=8 measures ~0.8 acceptance (CPU
+    check: tests/test_spec_decode.py::test_rag_quoting_construction).
+
+    Measures fused spec-burst vs plain 16-step bursts at bs=1 AND bs=4 on
+    the same workload — the gate VERDICT r04 asks for before spec can be
+    recommended beyond bs=1."""
+    import dataclasses
+
+    from githubrepostorag_tpu.models import init_params
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+    cfg = dataclasses.replace(cfg0, tie_word_embeddings=False)
+    params = init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.bfloat16)
+    params = dict(params,
+                  layers=jax.tree.map(jnp.zeros_like, params["layers"]),
+                  lm_head=jnp.roll(params["embed"], 1, axis=0).T)
+    jax.block_until_ready(params)
+    gen, span, n_chunks = 256, 32, 8
+    sp = SamplingParams(max_tokens=gen, temperature=0.0, stop_token_ids=())
+    use_pallas = jax.default_backend() == "tpu"
+
+    def rag_prompt(seed: int) -> list[int]:
+        rng = np.random.default_rng(seed)
+        s0 = int(rng.integers(1024, cfg.vocab_size - span * n_chunks - gen - 2))
+        chunk_list = [list(range(s0 + span * j, s0 + span * (j + 1)))
+                      for j in range(n_chunks)]
+        return [t for j in rng.permutation(n_chunks)
+                for t in chunk_list[j]] + [s0]
+
+    def build(spec: bool) -> "Engine":
+        kw = dict(spec_ngram_k=8, spec_burst_iters=16) if spec else \
+            dict(decode_burst=16)
+        return Engine(params, cfg, max_num_seqs=4, num_pages=48, page_size=64,
+                      max_seq_len=1024, prefill_chunk=256,
+                      use_pallas=use_pallas, **kw)
+
+    out: dict[str, float] = {}
+    acc_prop = acc_acc = 0
+    for tag, spec in (("spec", True), ("burst", False)):
+        eng = build(spec)
+        eng.generate([rag_prompt(900)], sp)  # warm: compiles both row shapes
+        eng.generate([rag_prompt(901 + i) for i in range(4)], sp)
+        for bs in (1, 4):
+            p0, a0 = getattr(eng, "spec_proposed", 0), getattr(eng, "spec_accepted", 0)
+            t0 = time.monotonic()
+            res = eng.generate([rag_prompt(1000 + 10 * bs + i) for i in range(bs)], sp)
+            out[f"{tag}_bs{bs}"] = time.monotonic() - t0
+            assert all(len(r.output_tokens) == gen for r in res)
+            if spec:
+                acc_prop += eng.spec_proposed - p0
+                acc_acc += eng.spec_accepted - a0
+        del eng
+        gc.collect()
+    out["acceptance"] = acc_acc / max(acc_prop, 1)
+    log(f"bench[spec-rag]: acceptance {out['acceptance']:.2f}; spec bs1 "
+        f"{out['spec_bs1']:.2f}s vs burst {out['burst_bs1']:.2f}s "
+        f"({out['burst_bs1'] / out['spec_bs1']:.2f}x); bs4 "
+        f"{out['spec_bs4']:.2f}s vs {out['burst_bs4']:.2f}s "
+        f"({out['burst_bs4'] / out['spec_bs4']:.2f}x)")
+    return out
 
 
 def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
@@ -499,20 +626,37 @@ def _main() -> None:
             # prefill_widths=2: the 128-token prompts dispatch at width 128
             # instead of padding to the 256 chunk — halves the prompt-wave
             # FLOPs that dominate p50 TTFT under simultaneous arrival
-            eng7c = Engine(params7, cfg7, max_num_seqs=64, num_pages=320,
-                           page_size=64, max_seq_len=1024, prefill_chunk=256,
+            # page_size=128 measured BEST of {64, 128, 256} (r05 real-chip
+            # probe, 3-trial medians): 2473 tok/s agg / 0.95 s p50, vs
+            # 2234 / 1.01 at 64 and 2040 / 1.75 at 256.  Two effects trade:
+            # bigger pages walk fewer Pallas grid steps per decode (decode
+            # wall 2.65 / 2.35 / 2.25 s) but 128-token prompts committing
+            # into wider-than-128 pages pay KV write amplification in the
+            # prompt wave (wave 1.02 / 0.96 / 1.76 s).  128 = exact page
+            # fill for this workload's prompts AND a halved page walk.
+            eng7c = Engine(params7, cfg7, max_num_seqs=64, num_pages=160,
+                           page_size=128, max_seq_len=1024, prefill_chunk=256,
                            use_pallas=True, decode_burst=32,
                            prefill_priority=True, prefill_widths=2)
             log("bench[64seq-7b-int8]: warmup (compiles all row buckets)")
             eng7c.warmup()
-            agg7, p507 = bench_concurrency(cfg7, streams=64, prompt_len=128,
-                                           gen_tokens=128, engine=eng7c)
+            # trials=3, keep median: one ~25 s stall in a ~3.5 s run is the
+            # 8x driver-vs-builder swing of r04 — the median of three fresh
+            # waves survives it, and the phase extras prove which it was
+            agg7, p507, ph7 = bench_concurrency(
+                cfg7, streams=64, prompt_len=128, gen_tokens=128,
+                engine=eng7c, trials=3)
             # no decode_extras here: conc walls include prefill + stream
             # drain, so agg/64*bytes is not a sustained-bandwidth claim
             emit("concurrent64_agg_tok_s_qwen2-7b_int8", agg7, "tok/s",
-                 agg7 / BASELINE_TOK_S)
+                 agg7 / BASELINE_TOK_S, **ph7)
             emit("concurrent64_p50_ttft_qwen2-7b_int8", p507, "s",
                  BASELINE_TTFT_S / max(p507, 1e-9))
+            # phase scalars as their own records so the driver's 2000-char
+            # tail (bench_summary values only) still carries the breakdown
+            emit("conc64_7b_prompt_wave_s", ph7["prompt_wave_s"], "s", None)
+            emit("conc64_7b_decode_wall_s", ph7["decode_wall_s"], "s", None)
+            emit("conc64_7b_max_step_s", ph7["max_step_s"], "s", None)
             del eng7c
         del params7
         gc.collect()
@@ -538,6 +682,26 @@ def _main() -> None:
         emit("decode_tok_s_per_chip_qwen2-1.5b_int8_bs8", tps15q, "tok/s",
              tps15q / BASELINE_TOK_S,
              **decode_extras(tps15q, 8, streamed_nbytes(params15q)))
+        # ---- the SERVED DEFAULT stack as ONE number (VERDICT r04 next #9):
+        # int8 weights + int8 KV + prefix caching + width-bucketed prefill
+        # + prefill-priority — the composition helm/values.yaml actually
+        # deploys, measured together instead of per-feature isolates
+        if budget_allows("served-default-conc64", 240):
+            engsd = Engine(params15q, cfg15q, max_num_seqs=64, num_pages=320,
+                           page_size=64, max_seq_len=1024, prefill_chunk=256,
+                           use_pallas=True, decode_burst=32, kv_quant=True,
+                           prefill_priority=True, prefill_widths=2,
+                           prefix_caching=True)
+            log("bench[served-default-conc64]: warmup (full served stack)")
+            engsd.warmup()
+            aggsd, p50sd, phsd = bench_concurrency(
+                cfg15q, streams=64, prompt_len=128, gen_tokens=128,
+                engine=engsd, trials=2)
+            emit("served_default_conc64_agg_tok_s_qwen2-1.5b", aggsd, "tok/s",
+                 aggsd / BASELINE_TOK_S, **phsd)
+            emit("served_default_conc64_p50_ttft_qwen2-1.5b", p50sd, "s",
+                 BASELINE_TTFT_S / max(p50sd, 1e-9))
+            del engsd
         del params15q
         gc.collect()
 
@@ -581,6 +745,30 @@ def _main() -> None:
         del eng_pc
         gc.collect()
 
+    # ---- long-context prefill TTFT: 8k-token prompt on 1.5B --------------
+    # (VERDICT r04 next #8: sp ring prefill is parity-tested on the dryrun
+    # mesh but the long-context axis had no single-chip perf evidence; this
+    # is the chunked-prefill TTFT a served 8k RAG context actually pays)
+    if params15 is not None and budget_allows("long-prefill-1.5b", 150):
+        from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+        eng_lp = Engine(params15, cfg15, max_num_seqs=2, num_pages=72,
+                        page_size=256, max_seq_len=8448, prefill_chunk=512,
+                        use_pallas=True, decode_burst=16)
+        eng_lp.warmup()
+        sp8k = SamplingParams(max_tokens=16, temperature=0.0, stop_token_ids=())
+        ttfts_8k = []
+        for t in range(3):  # fresh prompts: prefix caching must not help
+            p8k = _prompts(1, 8192, cfg15.vocab_size, seed=31 + t)[0]
+            ttfts_8k.append(eng_lp.generate([p8k], sp8k)[0].ttft_s)
+        ttfts_8k.sort()
+        log(f"bench[long-prefill-1.5b]: 8192-token prompt TTFT "
+            f"{[round(t, 3) for t in ttfts_8k]} (median {ttfts_8k[1]:.3f}s)")
+        emit("long_prefill_ttft_qwen2-1.5b_8k", ttfts_8k[1], "s", None,
+             trials=[round(t, 3) for t in ttfts_8k])
+        del eng_lp
+        gc.collect()
+
     # ---- eval config #5 in its stated regime: 64 streams on 1.5B ---------
     if params15 is not None and budget_allows("concurrent64-1.5b", 180):
         eng15c = Engine(params15, cfg15, max_num_seqs=64, num_pages=320,
@@ -588,10 +776,11 @@ def _main() -> None:
                         use_pallas=True, decode_burst=32, prefill_widths=2)
         log("bench[64seq-1.5b]: warmup (compiles all row buckets)")
         eng15c.warmup()
-        agg15, p5015 = bench_concurrency(cfg15, streams=64, prompt_len=128,
-                                         gen_tokens=128, engine=eng15c)
+        agg15, p5015, ph15 = bench_concurrency(cfg15, streams=64, prompt_len=128,
+                                               gen_tokens=128, engine=eng15c,
+                                               trials=2)
         emit("concurrent64_agg_tok_s_qwen2-1.5b", agg15, "tok/s",
-             agg15 / BASELINE_TOK_S)
+             agg15 / BASELINE_TOK_S, **ph15)
         emit("concurrent64_p50_ttft_qwen2-1.5b", p5015, "s",
              BASELINE_TTFT_S / max(p5015, 1e-9))
         del eng15c
@@ -656,11 +845,11 @@ def _main() -> None:
                           kv_quant=quant)
             log(f"bench[kvquant-capacity-{tag}]: warmup")
             engc.warmup()
-            agg, p50 = bench_concurrency(cfg05, streams=64, prompt_len=512,
-                                         gen_tokens=128, engine=engc)
+            agg, p50, phc = bench_concurrency(cfg05, streams=64, prompt_len=512,
+                                              gen_tokens=128, engine=engc)
             agg_by[tag] = agg
             emit(f"kvquant_capacity_agg_tok_s_qwen2-0.5b_{tag}", agg, "tok/s",
-                 agg / BASELINE_TOK_S)
+                 agg / BASELINE_TOK_S, **phc)
             del engc
             gc.collect()
         emit("kvquant_equal_hbm_speedup_qwen2-0.5b",
@@ -677,6 +866,16 @@ def _main() -> None:
         emit("spec_burst_speedup_vs_burst_bs1_qwen2-0.5b",
              burst_wall / max(sburst_wall, 1e-9), "x", None)
 
+    # ---- speculative decoding on a RAG-shaped QUOTING workload -----------
+    # (VERDICT r04 next #5: acceptance < 1, and the bs>1 gate)
+    if budget_allows("spec-decode-rag", 180):
+        rag = bench_spec_decode_rag(cfg05)
+        emit("spec_rag_acceptance_qwen2-0.5b", rag["acceptance"], "ratio", None)
+        emit("spec_rag_burst_speedup_bs1_qwen2-0.5b",
+             rag["burst_bs1"] / max(rag["spec_bs1"], 1e-9), "x", None)
+        emit("spec_rag_burst_speedup_bs4_qwen2-0.5b",
+             rag["burst_bs4"] / max(rag["spec_bs4"], 1e-9), "x", None)
+
     # ---- eval configs #5 + #4 on 0.5B (continuity with r01/r02) ----------
     if budget_allows("concurrent64-0.5b", 180):
         eng = Engine(params05_or_init(), cfg05, max_num_seqs=64, num_pages=320, page_size=64,
@@ -685,9 +884,10 @@ def _main() -> None:
         log("bench[64seq]: warmup (compiles all row buckets)")
         eng.warmup()
 
-        agg, p50 = bench_concurrency(cfg05, streams=64, prompt_len=128,
-                                     gen_tokens=128, engine=eng)
-        emit("concurrent64_agg_tok_s_qwen2-0.5b", agg, "tok/s", agg / BASELINE_TOK_S)
+        agg, p50, ph05 = bench_concurrency(cfg05, streams=64, prompt_len=128,
+                                           gen_tokens=128, engine=eng)
+        emit("concurrent64_agg_tok_s_qwen2-0.5b", agg, "tok/s",
+             agg / BASELINE_TOK_S, **ph05)
         emit("concurrent64_p50_ttft_qwen2-0.5b", p50, "s", BASELINE_TTFT_S / max(p50, 1e-9))
 
         if budget_allows("extractor", 60):
@@ -709,10 +909,10 @@ def _main() -> None:
                       prefill_widths=2)
         log("bench[64seq-kvquant]: warmup (compiles all row buckets)")
         engq.warmup()
-        aggq, p50q = bench_concurrency(cfg05, streams=64, prompt_len=128,
-                                       gen_tokens=128, engine=engq)
+        aggq, p50q, phq = bench_concurrency(cfg05, streams=64, prompt_len=128,
+                                            gen_tokens=128, engine=engq)
         emit("concurrent64_agg_tok_s_qwen2-0.5b_kvquant_int8", aggq, "tok/s",
-             aggq / BASELINE_TOK_S)
+             aggq / BASELINE_TOK_S, **phq)
         emit("concurrent64_p50_ttft_qwen2-0.5b_kvquant_int8", p50q, "s",
              BASELINE_TTFT_S / max(p50q, 1e-9))
         del engq
@@ -821,7 +1021,28 @@ def _main() -> None:
         nbytes_moe = streamed_nbytes(params_moe)
         emit("decode_tok_s_per_chip_qwen2-moe-16e_bs8", tps_moe, "tok/s",
              tps_moe / BASELINE_TOK_S, **decode_extras(tps_moe, 8, nbytes_moe))
-        del params_moe
+        # ---- int8 MoE (VERDICT r04 next #4): the bf16 16-expert row sat a
+        # hair under the 2000 floor (1992.6, 68% of roofline); per-expert
+        # stacked-scale int8 (tested in test_moe.py) halves the streamed
+        # expert bytes — quantize the RESIDENT bf16 tree on device
+        if budget_allows("moe-int8-decode", 120):
+            from githubrepostorag_tpu.models.quant import quantize_qwen2_params
+
+            log("bench[qwen2-moe-16e-int8]: quantizing the resident tree on device")
+            params_moe_q = quantize_qwen2_params(params_moe)
+            jax.block_until_ready(params_moe_q)
+            del params_moe
+            gc.collect()
+            tps_moeq, _, _ = bench_decode(
+                cfg_moe, "qwen2-moe-16e-int8", batch=8, prompt_len=128,
+                gen_tokens=256, num_pages=64, page_size=256, max_seq=1024,
+                decode_burst=128, runs=2, params=params_moe_q)
+            emit("decode_tok_s_per_chip_qwen2-moe-16e_int8_bs8", tps_moeq,
+                 "tok/s", tps_moeq / BASELINE_TOK_S,
+                 **decode_extras(tps_moeq, 8, streamed_nbytes(params_moe_q)))
+            del params_moe_q
+        else:
+            del params_moe
         gc.collect()
 
 

@@ -153,10 +153,11 @@ class GraphAgent:
 
     def retrieve(self, state: AgentState) -> None:
         retriever = self.retrievers.for_scope(state.scope)
-        docs = retriever.retrieve(state.query, state.filters)
+        cap = state.top_k if state.top_k and state.top_k > 0 else self.router_top_k
+        docs = retriever.retrieve(state.query, state.filters, top_k=state.top_k)
         original_count = len(docs)
 
-        if (len(docs) < 3 or state.attempt > 0) and len(docs) < self.router_top_k:
+        if (len(docs) < 3 or state.attempt > 0) and len(docs) < cap:
             expanded = self._expand_query(state.query, state.filters.get("repo"), state.scope)
             # collect every expansion candidate first, then rank — capping by
             # insertion order would drop stronger docs from later queries
@@ -164,7 +165,8 @@ class GraphAgent:
             extras: list[RetrievedDoc] = []
             for alt in expanded:
                 try:
-                    for doc in retriever.retrieve(alt, state.filters):
+                    for doc in retriever.retrieve(alt, state.filters,
+                                                  top_k=state.top_k):
                         h = hash(doc.text)
                         if h not in seen:
                             seen.add(h)
@@ -172,7 +174,7 @@ class GraphAgent:
                 except Exception as exc:  # noqa: BLE001 - expansion is best-effort
                     logger.warning("expanded query %r failed: %s", alt, exc)
             extras.sort(key=lambda d: d.score, reverse=True)
-            all_docs = (list(docs) + extras)[: self.router_top_k]
+            all_docs = (list(docs) + extras)[:cap]
             if len(all_docs) > original_count:
                 state.breadcrumb(
                     "retrieve_expanded",
@@ -277,7 +279,8 @@ class GraphAgent:
         if not docs:
             flt = {k: v for k, v in state.filters.items() if k == "namespace"}
             try:
-                docs = self.retrievers.retrieve("chunk", state.original_query, flt)
+                docs = self.retrievers.retrieve("chunk", state.original_query,
+                                                flt, top_k=state.top_k)
             except Exception:  # noqa: BLE001
                 docs = []
             if docs:
@@ -369,8 +372,10 @@ class GraphAgent:
         force_level: str | None = None,
         should_stop: Callable[[], bool] | None = None,
         token_cb: Callable[[str], None] | None = None,
+        top_k: int | None = None,
     ) -> AgentResult:
-        state = AgentState(query=question, original_query=question, progress_cb=progress_cb)
+        state = AgentState(query=question, original_query=question,
+                           progress_cb=progress_cb, top_k=top_k)
         if namespace or self.namespace:
             state.filters["namespace"] = namespace or self.namespace
 

@@ -20,6 +20,9 @@ class AgentState:
     scope: str = "repo"
     filters: dict[str, str] = field(default_factory=dict)
     attempt: int = 0
+    top_k: int | None = None  # per-request result cap (QueryRequest.top_k —
+    # the reference declared it, rag_shared/models.py:6-9, but never read it;
+    # None falls back to settings ROUTER_TOP_K)
     docs: list[RetrievedDoc] = field(default_factory=list)
     best_docs: list[RetrievedDoc] = field(default_factory=list)  # last non-empty retrieval
     needs_more: bool = False

@@ -463,8 +463,14 @@ def _devrand(shape: tuple, salt: jnp.ndarray, kind: str) -> jnp.ndarray:
     if kind == "u8":
         return (h & jnp.uint32(0xFF)).astype(jnp.uint8)
     if kind == "i8":
-        return jax.lax.bitcast_convert_type(
-            (h & jnp.uint32(0xFF)).astype(jnp.uint8), jnp.int8
+        # clamp -128 -> -127: real checkpoints clip symmetric int8 to
+        # +-127, and the documented "uniform int8 std ~73" scale
+        # derivation assumes that range (ADVICE r04)
+        return jnp.maximum(
+            jax.lax.bitcast_convert_type(
+                (h & jnp.uint32(0xFF)).astype(jnp.uint8), jnp.int8
+            ),
+            jnp.int8(-127),
         )
     assert kind == "bf16", kind
     # uniform [0, 2^32) -> centered, std ~ 0.02 (uniform std = range/sqrt(12))

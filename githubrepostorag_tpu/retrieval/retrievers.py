@@ -116,8 +116,13 @@ class ScopeRetriever:
         self.spec = spec or SCOPE_SPECS[scope]
         self.table = table or get_settings().scope_tables[self.spec.table_key]
 
-    def retrieve(self, query: str, filters: Mapping[str, str] | None = None) -> list[RetrievedDoc]:
+    def retrieve(self, query: str, filters: Mapping[str, str] | None = None,
+                 top_k: int | None = None) -> list[RetrievedDoc]:
+        """``top_k`` overrides the scope spec's result cap ``k`` for this
+        call (per-request QueryRequest.top_k); the traversal fan-out
+        (start_k/adjacent_k/depth) stays spec-driven."""
         spec = self.spec
+        cap = top_k if top_k and top_k > 0 else spec.k
         qvec = self.encoder.encode([query], kind="query")[0]
         flt = dict(filters or {})
 
@@ -170,9 +175,9 @@ class ScopeRetriever:
                 break
 
         if spec.mmr_lambda is not None:
-            return mmr_select(list(found.values()), vectors, spec.k, spec.mmr_lambda)
+            return mmr_select(list(found.values()), vectors, cap, spec.mmr_lambda)
         ranked = sorted(found.values(), key=lambda d: d.score, reverse=True)
-        return ranked[: spec.k]
+        return ranked[:cap]
 
 
 class RetrieverFactory:
@@ -194,5 +199,6 @@ class RetrieverFactory:
             self._cache[scope] = ScopeRetriever(self.store, self.encoder, scope)
         return self._cache[scope]
 
-    def retrieve(self, scope: str, query: str, filters: Mapping[str, str] | None = None) -> list[RetrievedDoc]:
-        return self.for_scope(scope).retrieve(query, filters)
+    def retrieve(self, scope: str, query: str, filters: Mapping[str, str] | None = None,
+                 top_k: int | None = None) -> list[RetrievedDoc]:
+        return self.for_scope(scope).retrieve(query, filters, top_k=top_k)

@@ -333,6 +333,15 @@ class Engine:
         return len(self._row_req)
 
     @property
+    def is_admitting(self) -> bool:
+        """True while a prompt wave is still being admitted — requests are
+        queued or mid-prefill.  Drives prefill-priority scheduling and lets
+        callers (bench phase attribution) classify the next step without
+        reaching into engine privates."""
+        return bool(self._waiting) or any(
+            r.state == "prefilling" for r in self._row_req.values())
+
+    @property
     def num_waiting(self) -> int:
         return len(self._waiting)
 
@@ -355,12 +364,7 @@ class Engine:
 
         prefilled = self._try_prefill(finished)
         running = [r for r in self._row_req.values() if r.state == "running"]
-        if (
-            self.prefill_priority
-            and prefilled
-            and (self._waiting
-                 or any(r.state == "prefilling" for r in self._row_req.values()))
-        ):
+        if self.prefill_priority and prefilled and self.is_admitting:
             # prefill-priority: a chunk ran and prompts remain — give the
             # next step to admission instead of a decode burst.  No
             # starvation: once nothing can prefill, ``prefilled`` is False
@@ -1170,6 +1174,16 @@ class Engine:
                     # by the dedicated loop below
                     plen = min(plen, self.sp_prefill_threshold - 1)
                 if plen <= 0:
+                    # Skipping is provably safe, not a warm-coverage gap
+                    # (ADVICE r04 suggested an all-short fallback wave; it
+                    # is unnecessary): plen<=0 via the page budget needs
+                    # num_pages <= (nb-1)*short_pages, i.e. no page left
+                    # for an nb-th row — live traffic can never run nb
+                    # simultaneous rows either, so (nb, *) is unreachable.
+                    # The only other source is an sp_prefill_threshold <= 1
+                    # clamp, where EVERY live prompt routes to ring prefill
+                    # (warmed by the dedicated loop below), never to these
+                    # chunked shapes.
                     continue
                 # the width this wave will actually dispatch at (page caps
                 # can collapse several w's onto one shape — run it once)

@@ -100,6 +100,11 @@ class RagWorker:
         query = req.get("query", "")
         namespace = req.get("namespace") or get_settings().default_namespace
         force_level = req.get("force_level")
+        # per-request result cap — the schema drift the reference shipped
+        # (QueryRequest declared top_k, the worker never read it)
+        top_k = req.get("top_k")
+        if not isinstance(top_k, int) or isinstance(top_k, bool) or top_k <= 0:
+            top_k = None
         start = time.monotonic()
 
         await self.bus.emit(job_id, "started", {"job_id": job_id, "query": query})
@@ -143,7 +148,7 @@ class RagWorker:
                 lambda: self.agent.run(
                     query, namespace=namespace, progress_cb=progress_cb,
                     force_level=force_level, should_stop=cancelled.is_set,
-                    token_cb=token_cb,
+                    token_cb=token_cb, top_k=top_k,
                 ),
             )
         except RunCancelled:

@@ -1,0 +1,42 @@
+"""One-off real-chip validation of the 7B int8 conc64 item geometry
+(VERDICT r04 next #1).  Not part of the bench run — a builder-side probe
+that the page_size=256 / trials=3 item holds >= 2000 tok/s with p50 TTFT
+<= 1.5 s before the driver ever sees it.
+
+Usage: python scripts/validate_conc64_7b.py [page_size num_pages]
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402  (enables the persistent compile cache)
+import jax  # noqa: E402
+
+from githubrepostorag_tpu.models.quant import init_params_quantized  # noqa: E402
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config  # noqa: E402
+from githubrepostorag_tpu.serving.engine import Engine  # noqa: E402
+
+# defaults = the geometry bench.py ships (page_size=128 measured best of
+# {64, 128, 256} in the r05 probe — see the bench item's comment)
+page_size = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+num_pages = int(sys.argv[2]) if len(sys.argv) > 2 else 160
+
+cfg = Qwen2Config.qwen2_7b()
+t0 = time.monotonic()
+bench.log("validate: building int8 7B params on device")
+params = init_params_quantized(cfg, bits=8, fuse=True)
+jax.block_until_ready(params)
+bench.log(f"validate: params resident in {time.monotonic() - t0:.1f}s")
+
+eng = Engine(params, cfg, max_num_seqs=64, num_pages=num_pages,
+             page_size=page_size, max_seq_len=1024, prefill_chunk=256,
+             use_pallas=True, decode_burst=32, prefill_priority=True,
+             prefill_widths=2)
+t0 = time.monotonic()
+eng.warmup()
+bench.log(f"validate: warmup in {time.monotonic() - t0:.1f}s")
+
+agg, p50, ph = bench.bench_concurrency(cfg, streams=64, prompt_len=128,
+                                       gen_tokens=128, engine=eng, trials=3)
+bench.log(f"validate: page_size={page_size} num_pages={num_pages} "
+          f"-> median agg {agg:.1f} tok/s, p50 TTFT {p50:.3f}s, phases {ph}")

@@ -1,0 +1,195 @@
+"""Agent-loop fuzz against HOSTILE LLM outputs (VERDICT r04 next #6).
+
+The reference's JSON-robustness fallbacks are load-bearing for answer
+quality (agent_graph.py:226-228,346-355 parse-fail stage-down; SURVEY §7
+"hardest parts" #5).  test_agent.py proves each fallback branch in
+isolation; this file drives hundreds of randomized FULL ``GraphAgent.run``
+calls where every LLM call returns adversarial text — malformed JSON,
+truncated JSON, wrong types, unknown/pluralized/hostile filter keys,
+up-the-ladder scope suggestions, empty strings, ``Error:`` strings, think
+tags, control bytes — and asserts the run-level invariants:
+
+  1. every run terminates with an AgentResult (bounded by max_iters);
+  2. the answer is always a string and sources are well-formed dicts;
+  3. filters never gain keys outside the canonical metadata vocabulary
+     (an unknown key would zero every later retrieval);
+  4. the retrieval scope only ever moves DOWN the ladder.
+"""
+
+from __future__ import annotations
+
+import random
+
+from githubrepostorag_tpu.agent import GraphAgent
+from githubrepostorag_tpu.agent.graph import SYNTH_MAX_BLOCKS
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.embedding import HashingTextEncoder
+from githubrepostorag_tpu.retrieval import RetrieverFactory
+from githubrepostorag_tpu.retrieval.retrievers import SCOPE_LADDER
+from githubrepostorag_tpu.store import Doc, MemoryVectorStore
+
+CANONICAL_FILTER_KEYS = {"namespace", "repo", "module", "file_path", "topics", "scope"}
+
+# Adversarial completions: every shape of LLM misbehavior the reference's
+# fallbacks exist for, plus a few it never considered.
+HOSTILE_OUTPUTS = [
+    "",
+    "   \n\t  ",
+    "not json at all, just prose about the question",
+    '{"scope": "galaxy", "filters": {"planet": "mars"}}',  # unknown scope+key
+    '{"scope": "catalog"',  # truncated mid-object
+    '{"coverage": "very high", "needs_more": "yes please"}',  # wrong types
+    '{"stage_down": "catalog"}',  # UP the ladder — must be refused
+    '{"suggest_filters": {"repos": ["r1", "r2"], "unknown_key": "x", "topicss": 3}}',
+    "[1, 2, 3]",
+    '"just a quoted string"',
+    "null",
+    "Error: model overloaded, please retry",  # errors-as-text contract
+    '{"coverage": 0.9, "needs_more": false} trailing garbage after the JSON',
+    '<think>let me think about this...</think>{"coverage": 0.1, "needs_more": true}',
+    '{"coverage": NaN, "needs_more": true}',
+    "\x00\x01 binary junk \x7f",
+    '{"rewrite": 42, "needs_more": true}',  # rewrite wrong type
+    "{}",
+    '{"scope": "chunk", "filters": {"repo": null, "module": ["m1"], "file_path": {}}}',
+    '{"coverage": -7.5, "needs_more": true, "stage_down": "file"}',
+    "ok",  # too short for a rewrite
+    '{"needs_more": true, "rewrite": ""}',
+    '```json\n{"coverage": 0.5, "needs_more": true}\n```',  # fenced
+    '{"suggest_filters": {"scope": "delete everything", "namespace": "evil"}}',
+]
+
+
+class HostileLLM:
+    """Returns a seeded-random hostile completion for EVERY call."""
+
+    def __init__(self, seed: int) -> None:
+        self.rng = random.Random(seed)
+        self.calls = 0
+
+    def complete(self, prompt, *, system=None, max_tokens=None, temperature=None) -> str:
+        self.calls += 1
+        out = self.rng.choice(HOSTILE_OUTPUTS)
+        if self.rng.random() < 0.2:  # random truncation of whatever it was
+            out = out[: self.rng.randint(0, max(len(out) - 1, 0))]
+        return out
+
+    def stream_complete(self, prompt, *, system=None, max_tokens=None,
+                        temperature=None, on_text=None):
+        text = self.complete(prompt)
+        for piece in (text[i:i + 7] for i in range(0, len(text), 7)) if text else [""]:
+            if on_text:
+                on_text(piece)
+            yield piece
+
+
+def _populated_factory() -> RetrieverFactory:
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    tables = get_settings().scope_tables
+    fixtures = {
+        "catalog": [("cat1", "catalog of repositories in namespace default", {})],
+        "repo": [("r1", "repo one: a message broker in java", {"repo": "broker"}),
+                 ("r2", "repo two: cassandra client library", {"repo": "cassclient"})],
+        "module": [("m1", "module consumer handles message consumption",
+                    {"repo": "broker", "module": "consumer"})],
+        "file": [("f1", "file Consumer.java implements the consumer loop",
+                  {"repo": "broker", "module": "consumer", "file_path": "Consumer.java"})],
+        "chunk": [("c1", "class Consumer { void poll() { /* reconnect retry */ } }",
+                   {"repo": "broker", "module": "consumer", "file_path": "Consumer.java"}),
+                  ("c2", "def reconnect(): backoff and retry the session",
+                   {"repo": "cassclient", "module": "net", "file_path": "net/session.py"}),
+                  ("c3", "cache configuration yaml for the api tier",
+                   {"repo": "broker", "module": "config", "file_path": "config/cache.yaml"})],
+    }
+    for scope, rows in fixtures.items():
+        store.upsert(tables[scope], [
+            Doc(d, t, {"namespace": "default", "scope": scope, **m}, enc.encode([t])[0])
+            for d, t, m in rows
+        ])
+    return RetrieverFactory(store, enc)
+
+
+QUERIES = [
+    "how does the consumer reconnect after a timeout exception?",  # codey
+    "tell me about the projects in this workspace",  # overview
+    "repo: broker how is caching configured",  # repo hint
+    "what is in repository cassclient",
+    "",  # empty query
+    "x" * 500,  # absurdly long query
+]
+
+
+def _ladder_idx(scope: str) -> int:
+    return SCOPE_LADDER.index(scope) if scope in SCOPE_LADDER else -1
+
+
+def test_agent_fuzz_hostile_llm_full_runs():
+    factory = _populated_factory()
+    empty_factory = RetrieverFactory(MemoryVectorStore(), HashingTextEncoder())
+    rng = random.Random(0xC0FFEE)
+
+    for trial in range(250):
+        llm = HostileLLM(seed=trial)
+        agent = GraphAgent(
+            llm,
+            factory if rng.random() < 0.8 else empty_factory,
+            max_iters=rng.choice([1, 2, 3, 4]),
+            namespace="default" if rng.random() < 0.7 else None,
+        )
+        force = rng.choice([None, None, "bogus_level", *SCOPE_LADDER])
+        tokens: list[str] = []
+        result = agent.run(
+            rng.choice(QUERIES),
+            force_level=force,
+            top_k=rng.choice([None, 1, 3, 50, -2]),
+            token_cb=tokens.append if rng.random() < 0.5 else None,
+        )
+
+        # 1. terminated with a well-formed result
+        assert isinstance(result.answer, str)
+        assert isinstance(result.sources, list)
+        assert len(result.sources) <= SYNTH_MAX_BLOCKS
+        for s in result.sources:
+            assert {"id", "doc_id", "repo", "module", "file_path",
+                    "scope", "score", "text"} <= set(s)
+
+        turns = result.debug.get("turns", [])
+        judges = [t for t in turns if t["stage"] == "judge"]
+        assert len(judges) <= agent.max_iters + 1
+
+        # 3. filters never gain non-canonical keys (hostile suggest_filters)
+        for t in turns:
+            for key in t.get("filters", {}):
+                assert key in CANONICAL_FILTER_KEYS, (trial, key, t)
+
+        # 4. scope only ever moves down the ladder (ignore the synthesize
+        # last-resort chunk probe, which doesn't change the run's scope)
+        scopes = [t["scope"] for t in turns
+                  if t["stage"] in ("plan", "retrieve") and not t.get("last_resort")]
+        assert scopes, turns
+        assert all(s in SCOPE_LADDER for s in scopes)
+        idxs = [_ladder_idx(s) for s in scopes]
+        assert idxs == sorted(idxs), (trial, scopes)
+
+
+def test_agent_fuzz_cancellation_still_clean():
+    """should_stop firing at a random stage raises RunCancelled (never a
+    stuck loop, never a partial-state crash)."""
+    import pytest
+
+    from githubrepostorag_tpu.agent import RunCancelled
+
+    factory = _populated_factory()
+    for trial in range(30):
+        # a single-iteration run probes should_stop exactly 5 times (before
+        # plan, retrieve, judge, rewrite, synthesize) — fire within that
+        fire_after = trial % 5
+        calls = {"n": 0}
+
+        def should_stop() -> bool:
+            calls["n"] += 1
+            return calls["n"] > fire_after
+
+        agent = GraphAgent(HostileLLM(seed=trial), factory, max_iters=3)
+        with pytest.raises(RunCancelled):
+            agent.run("how does the consumer reconnect?", should_stop=should_stop)

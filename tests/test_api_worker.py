@@ -227,3 +227,27 @@ async def test_tokens_stream_before_final_in_job_sse():
         final = events[-1]["data"]["answer"]
         assert streamed.strip() == final
     await _with_service(body)
+
+
+async def test_per_request_top_k_caps_retrieval():
+    """QueryRequest.top_k reaches the retriever (the reference declared it,
+    rag_shared/models.py:6-9, but its worker never read it): top_k=1 caps
+    that job's sources at one doc; the same query without top_k surfaces
+    all three fixture docs under settings ROUTER_TOP_K."""
+    async def body(session, base, api, worker):
+        resp = await session.post(f"{base}/rag/jobs", json={
+            "query": "how are jobs created?", "top_k": 1, "force_level": "chunk"})
+        job_id = (await resp.json())["job_id"]
+        events = await _collect_events(session, base, job_id)
+        final = events[-1]["data"]
+        assert len(final["sources"]) == 1
+        retrieval = next(e for e in events if e["event"] == "retrieval")
+        assert retrieval["data"]["sources_found"] == 1
+
+        resp = await session.post(f"{base}/rag/jobs", json={
+            "query": "how are jobs created?", "force_level": "chunk"})
+        job_id = (await resp.json())["job_id"]
+        events = await _collect_events(session, base, job_id)
+        assert len(events[-1]["data"]["sources"]) >= 2
+
+    await _with_service(body)

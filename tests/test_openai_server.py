@@ -228,3 +228,112 @@ async def test_multi_turn_chat_reuses_prefix_cache():
             await body(session, f"http://127.0.0.1:{port}")
     finally:
         await server.stop()
+
+
+def _write_awq_checkpoint(root) -> None:
+    """A freshly generated tiny AWQ-layout checkpoint on disk: config.json
+    with quantization_config.quant_method=awq + one safetensors shard whose
+    projections are AutoAWQ GEMM-packed qweight/qzeros/scales (the layout
+    Qwen2.5-Coder-7B-Instruct-AWQ ships — reference values.yaml:67)."""
+    from githubrepostorag_tpu.models.hf_loader import AWQ_NIBBLE_ORDER
+
+    rng = np.random.default_rng(3)
+    group = 16
+
+    def awq_pack(u4: np.ndarray) -> np.ndarray:
+        r, c = u4.shape
+        out = np.zeros((r, c // 8), dtype=np.uint32)
+        for pos, col in enumerate(AWQ_NIBBLE_ORDER):
+            out |= u4[:, col::8].astype(np.uint32) << np.uint32(4 * pos)
+        return out.view(np.int32)
+
+    def awq_linear(in_dim: int, out_dim: int) -> dict[str, np.ndarray]:
+        q = rng.integers(0, 16, (in_dim, out_dim), dtype=np.uint8)
+        z = rng.integers(0, 16, (in_dim // group, out_dim), dtype=np.uint8)
+        s = (rng.random((in_dim // group, out_dim), dtype=np.float32) * 0.05
+             + 0.005).astype(np.float16)
+        return {"qweight": awq_pack(q), "qzeros": awq_pack(z), "scales": s}
+
+    cfg = Qwen2Config.tiny()
+    h, q_out = cfg.hidden_size, cfg.num_heads * cfg.head_dim
+    kv_out, inter = cfg.num_kv_heads * cfg.head_dim, cfg.intermediate_size
+    state: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight":
+            (rng.standard_normal((cfg.vocab_size, h)) * 0.02).astype(np.float16),
+        "model.norm.weight": np.ones(h, dtype=np.float16),
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}"
+        state[f"{p}.input_layernorm.weight"] = np.ones(h, dtype=np.float16)
+        state[f"{p}.post_attention_layernorm.weight"] = np.ones(h, dtype=np.float16)
+        for name, dims in (("self_attn.q_proj", (h, q_out)),
+                           ("self_attn.k_proj", (h, kv_out)),
+                           ("self_attn.v_proj", (h, kv_out)),
+                           ("self_attn.o_proj", (q_out, h)),
+                           ("mlp.gate_proj", (h, inter)),
+                           ("mlp.up_proj", (h, inter)),
+                           ("mlp.down_proj", (inter, h))):
+            for suffix, tensor in awq_linear(*dims).items():
+                state[f"{p}.{name}.{suffix}"] = tensor
+        for bname, dim in (("q_proj", q_out), ("k_proj", kv_out), ("v_proj", kv_out)):
+            state[f"{p}.self_attn.{bname}.bias"] = (
+                rng.standard_normal(dim) * 0.01).astype(np.float16)
+
+    from safetensors.numpy import save_file
+
+    save_file(state, str(root / "model.safetensors"))
+    (root / "config.json").write_text(json.dumps({
+        "model_type": "qwen2",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": h,
+        "intermediate_size": inter,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": 1e-6,
+        "tie_word_embeddings": True,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "torch_dtype": "float16",
+        "quantization_config": {
+            "quant_method": "awq", "bits": 4, "version": "gemm",
+            "group_size": group, "zero_point": True,
+        },
+    }))
+
+
+async def test_awq_checkpoint_end_to_end(tmp_path):
+    """VERDICT r04 next #10: keep the real-weight path warm.  Round-trips a
+    freshly generated AWQ-layout checkpoint through hf_loader (AWQ
+    detection -> nibble repack -> QuantizedLinear4 stacks -> fused serving
+    layout) and the OpenAI server — the moment a real AWQ checkpoint ever
+    lands on a host, the same load_qwen2 + serve path runs it."""
+    import aiohttp
+
+    from githubrepostorag_tpu.models.hf_loader import load_qwen2
+    from githubrepostorag_tpu.models.quant import QuantizedLinear4
+
+    _write_awq_checkpoint(tmp_path)
+    params, cfg = load_qwen2(str(tmp_path), dtype=np.float32, fuse=True)
+    assert cfg.vocab_size == Qwen2Config.tiny().vocab_size
+    # the projections really are the in-tree int4 form (not dequantized)
+    assert isinstance(params["layers"]["wo"], QuantizedLinear4)
+
+    eng = Engine(params, cfg, max_num_seqs=2, num_pages=64, page_size=8,
+                 max_seq_len=128, prefill_chunk=32, kv_dtype=jnp.float32)
+    server = OpenAIServer(AsyncEngine(eng), ByteTokenizer(), model_name="tiny-awq")
+    port = await server.start(host="127.0.0.1", port=0)
+    try:
+        async with aiohttp.ClientSession() as session:
+            resp = await session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hello awq"}],
+                      "max_tokens": 8, "temperature": 0},
+            )
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["usage"]["completion_tokens"] > 0
+            assert isinstance(data["choices"][0]["message"]["content"], str)
+    finally:
+        await server.stop()

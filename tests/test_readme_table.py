@@ -1,0 +1,57 @@
+"""README perf-table drift gate (VERDICT r04 next #2).
+
+The r03 and r04 rounds both shipped a README whose perf table disagreed
+with the driver-visible evidence.  scripts/readme_perf_table.py now renders
+a driver column (latest BENCH_r0N.json tail) next to the builder column
+(BENCH_SUMMARY.json); this test regenerates that block from the committed
+artifacts and FAILS CI when README.md's block differs — hand-edits and
+stale tables can't reach a release.
+"""
+
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "scripts"))
+
+import readme_perf_table as rpt  # noqa: E402
+
+
+def test_readme_matches_committed_bench_artifacts():
+    text = (ROOT / "README.md").read_text()
+    i = text.index(rpt.START)
+    j = text.index(rpt.END) + len(rpt.END)
+    committed = text[i:j]
+    regenerated = rpt.render()
+    assert committed == regenerated, (
+        "README.md perf table drifted from the committed bench artifacts; "
+        "run: python scripts/readme_perf_table.py"
+    )
+
+
+def test_driver_summary_parses_from_latest_round_artifact():
+    name, summary = rpt.load_driver_summary()
+    assert name.startswith("BENCH_r")
+    # the flagship decode metric must be driver-visible
+    assert any(k.startswith("decode_tok_s_per_chip_qwen2-7b") for k in summary)
+
+
+def test_driver_summary_survives_front_truncated_tail(tmp_path):
+    """The driver keeps only the last ~2000 chars — the summary line may be
+    cut at the FRONT; per-metric recovery must still work."""
+    (tmp_path / "BENCH_r09.json").write_text(
+        '{"tail": "...cut...95.727,\\"x_a\\":80.3}}\\n{\\"metric\\": '
+        '\\"decode_tok_s_per_chip_qwen2-7b_int8_bs32\\", \\"value\\": 2191.0}", '
+        '"rc": 0}'
+    )
+    # no bench_summary key survived the cut -> falls through to no summary
+    name, summary = rpt.load_driver_summary(tmp_path)
+    assert (name, summary) == ("", {})
+
+    (tmp_path / "BENCH_r10.json").write_text(
+        '{"tail": "{\\"bench_summary\\":{\\"a_metric\\":1.5,'
+        '\\"b_metric\\":2191.055}}\\n{\\"metric\\": \\"a\\"}", "rc": 0}'
+    )
+    name, summary = rpt.load_driver_summary(tmp_path)
+    assert name == "BENCH_r10.json"
+    assert summary == {"a_metric": 1.5, "b_metric": 2191.055}

@@ -272,3 +272,42 @@ def test_spec_burst_falls_back_for_sampled_rows(tiny):
     res_p = plain.generate(prompts, sps)
     res_s = spec.generate(prompts, sps)
     assert res_s[0].output_tokens == res_p[0].output_tokens
+
+
+def test_rag_quoting_construction():
+    """The bench's RAG-shaped spec workload (bench_spec_decode_rag): zero
+    layers + an untied lm_head whose column o is embed row o-1 make greedy
+    argmax narrate the token cycle t -> t+1, and a prompt of SHUFFLED
+    consecutive cycle segments gives the bigram prompt-lookup drafter
+    partial acceptance — accepts inside each chunk's span, mispredicts at
+    chunk boundaries.  Guards the construction the driver-visible
+    spec_rag_* metrics depend on."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from githubrepostorag_tpu.models import Qwen2Config, init_params
+
+    cfg = dataclasses.replace(Qwen2Config.tiny(), tie_word_embeddings=False)
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    params = dict(params,
+                  layers=jax.tree.map(jnp.zeros_like, params["layers"]),
+                  lm_head=jnp.roll(params["embed"], 1, axis=0).T)
+
+    span, n_chunks, s0 = 16, 4, 100
+    rng = np.random.default_rng(17)
+    chunk_list = [list(range(s0 + span * j, s0 + span * (j + 1)))
+                  for j in range(n_chunks)]
+    prompt = [t for j in rng.permutation(n_chunks) for t in chunk_list[j]] + [s0]
+
+    sp = SamplingParams(max_tokens=40, temperature=0.0, stop_token_ids=())
+    eng = Engine(params, cfg, max_num_seqs=2, num_pages=32, page_size=16,
+                 max_seq_len=256, prefill_chunk=32, kv_dtype=jnp.float32,
+                 spec_ngram_k=8, spec_burst_iters=8)
+    out = eng.generate([prompt], sp)[0].output_tokens
+    # the model narrates the cycle (the "answer quotes the chunks")
+    assert out == list(range(s0 + 1, s0 + 41))
+    # and the drafter's acceptance is PARTIAL: well above chance, below 1.0
+    acceptance = eng.spec_accepted / max(eng.spec_proposed, 1)
+    assert 0.3 < acceptance < 1.0, acceptance
