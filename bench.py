@@ -36,6 +36,7 @@ parse both carry the flagship number no matter how many items ran
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -324,20 +325,27 @@ def bench_prefix_cache(cfg, *, engine, prefix_len: int, tag: str,
     ps = engine.page_size
     # prefix fills whole pages so the warm hit covers prefix_len tokens
     assert prefix_len % ps == 0, "align the shared prefix to page boundaries"
-    prefix = rng.integers(0, cfg.vocab_size, prefix_len).tolist()
     sp = SamplingParams(max_tokens=16, temperature=0.0, stop_token_ids=())
 
-    def one(tail_seed: int) -> float:
+    def one(prefix: list[int], tail_seed: int) -> float:
         tail = np.random.default_rng(tail_seed).integers(0, cfg.vocab_size, ps - 1).tolist()
         return engine.generate([prefix + tail], sp)[0].ttft_s
 
+    # cold = median over 3 DISTINCT prefixes: a single cold sample is one
+    # tunnel stall away from nonsense (r05 builder run 4 measured a 53 s
+    # cold where runs 1-3 measured ~0.3 s — same fragility class as the
+    # conc64 item; the warm side was already a median)
+    prefixes = [rng.integers(0, cfg.vocab_size, prefix_len).tolist()
+                for _ in range(3)]
     hits0 = engine._allocator.hit_tokens
-    cold = one(100)
-    warms = sorted(one(101 + i) for i in range(warm_requests))
+    colds = sorted(one(p, 100 + i) for i, p in enumerate(prefixes))
+    cold = colds[1]
+    warms = sorted(one(prefixes[0], 200 + i) for i in range(warm_requests))
     warm = warms[len(warms) // 2]
-    log(f"bench[{tag}]: cold TTFT {cold * 1e3:.1f} ms, warm median "
-        f"{warm * 1e3:.1f} ms ({engine._allocator.hit_tokens - hits0} tokens "
-        f"served from cache, ratio {warm / max(cold, 1e-9):.2f})")
+    log(f"bench[{tag}]: cold TTFT median {cold * 1e3:.1f} ms "
+        f"{[round(c * 1e3) for c in colds]}, warm median {warm * 1e3:.1f} ms "
+        f"({engine._allocator.hit_tokens - hits0} tokens served from cache, "
+        f"ratio {warm / max(cold, 1e-9):.2f})")
     return cold, warm
 
 
@@ -539,14 +547,15 @@ def bench_7b(bits: int, keep_params: bool = False):
     log(f"bench[{tag}]: {params_nbytes(params) / 1e9:.2f} GB on chip; compiling")
     # burst 32 (not 64): the 7B burst program's XLA compile time scales
     # with n_steps and already dominates a cold-cache run of this item.
-    # runs=1 and 96 tokens: the host->device weight transfer dominates the
-    # item's cost either way, and one run buys room for more items under
-    # the driver's budget (tunnel variance is ±10-15%; the multi-run
-    # medians are recorded in README/COVERAGE)
+    # runs=3: _devrand killed the 20-min host transfer that once justified
+    # runs=1, and a single ~1.4 s-decode-wall sample is one tunnel hiccup
+    # away from a 25% miss on the HEADLINE metric (r05 builder run 3
+    # measured 1562 where runs 1/2 measured 2142/2099 on identical code —
+    # the conc64 fragility class).  Three samples cost ~8 s warm.
     tps, _, _ = bench_decode(cfg, tag, batch=32, prompt_len=128,
                              gen_tokens=96, num_pages=160, page_size=256,
                              max_seq=1024, params=params, decode_burst=32,
-                             runs=1)
+                             runs=3)
     nbytes = streamed_nbytes(params)
     if keep_params:  # eval config #5 reuses the resident tree (the 7B
         # host->device transfer is the bench's most fragile phase)
@@ -581,8 +590,6 @@ def _main() -> None:
                                  max_seq=256, runs=1, decode_burst=16)
         emit("decode_tok_s_tiny_cpu", tps, "tok/s", tps / BASELINE_TOK_S)
         return
-
-    import gc
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------
     # decode_burst=128: throughput mode — device profiling shows the step
@@ -694,9 +701,11 @@ def _main() -> None:
                            prefix_caching=True)
             log("bench[served-default-conc64]: warmup (full served stack)")
             engsd.warmup()
+            # trials=3: with 2, the lower-middle pick reports a stalled
+            # trial (r05 run 5: first-wave stall 2770 vs healthy 3823)
             aggsd, p50sd, phsd = bench_concurrency(
                 cfg15q, streams=64, prompt_len=128, gen_tokens=128,
-                engine=engsd, trials=2)
+                engine=engsd, trials=3)
             emit("served_default_conc64_agg_tok_s_qwen2-1.5b", aggsd, "tok/s",
                  aggsd / BASELINE_TOK_S, **phsd)
             emit("served_default_conc64_p50_ttft_qwen2-1.5b", p50sd, "s",
@@ -778,7 +787,7 @@ def _main() -> None:
         eng15c.warmup()
         agg15, p5015, ph15 = bench_concurrency(cfg15, streams=64, prompt_len=128,
                                                gen_tokens=128, engine=eng15c,
-                                               trials=2)
+                                               trials=3)
         emit("concurrent64_agg_tok_s_qwen2-1.5b", agg15, "tok/s",
              agg15 / BASELINE_TOK_S, **ph15)
         emit("concurrent64_p50_ttft_qwen2-1.5b", p5015, "s",

@@ -419,17 +419,18 @@ def quantize_qwen2_params(
     )
     out = dict(params)
     layers = dict(params["layers"])
-    if "router" in layers:
-        # MoE: experts + shared expert quantize with stacked per-expert
-        # scales (the leading dims pass through both schemes).  The router
-        # and the [d, 1] shared gate stay full precision: they are tiny
-        # and routing decisions are the precision-sensitive part of a
-        # sparse model.
-        mlp_names = ("e_wg", "e_wu", "e_wd", "s_wg", "s_wu", "s_wd")
-    else:
-        mlp_names = ("wg", "wu", "wd")
-    for name in ("wq", "wk", "wv", "wo") + mlp_names:
-        layers[name] = qw(layers[name])
+    # Quantize every projection leaf PRESENT, covering all four layouts:
+    # dense/MoE x unfused/fused (fuse_projections renames wq|wk|wv -> wqkv
+    # and wg|wu -> wgu; a fused-at-init tree must quantize without being
+    # un-fused first).  MoE experts + shared expert quantize with stacked
+    # per-expert scales (the leading dims pass through both schemes); the
+    # router and the [d, 1] shared gate stay full precision — they are
+    # tiny and routing decisions are the precision-sensitive part of a
+    # sparse model.  Norms and biases are never in this list.
+    for name in ("wq", "wk", "wv", "wqkv", "wo", "wg", "wu", "wgu", "wd",
+                 "e_wg", "e_wu", "e_wd", "s_wg", "s_wu", "s_wd"):
+        if name in layers:
+            layers[name] = qw(layers[name])
     out["layers"] = layers
     if "lm_head" in params:
         out["lm_head"] = qw(params["lm_head"])

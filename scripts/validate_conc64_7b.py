@@ -1,7 +1,8 @@
 """One-off real-chip validation of the 7B int8 conc64 item geometry
 (VERDICT r04 next #1).  Not part of the bench run — a builder-side probe
-that the page_size=256 / trials=3 item holds >= 2000 tok/s with p50 TTFT
-<= 1.5 s before the driver ever sees it.
+that a candidate (page_size, num_pages) geometry holds >= 2000 tok/s with
+p50 TTFT <= 1.5 s over 3 fresh-prompt trials before bench.py ships it;
+defaults to the shipped geometry.
 
 Usage: python scripts/validate_conc64_7b.py [page_size num_pages]
 """
