@@ -694,8 +694,12 @@ def _main() -> None:
         # + prefill-priority — the composition helm/values.yaml actually
         # deploys, measured together instead of per-feature isolates
         if budget_allows("served-default-conc64", 240):
-            engsd = Engine(params15q, cfg15q, max_num_seqs=64, num_pages=320,
-                           page_size=64, max_seq_len=1024, prefill_chunk=256,
+            # page_size=128 (r05 probe, 3-trial medians): 4926 agg / 0.40 s
+            # p50 vs 4167 / 0.41 at page_size=64 — +18%: the kv_quant
+            # per-page dequant AND the Pallas page walk both halve their
+            # grid steps, and 128-token prompts still fill pages exactly
+            engsd = Engine(params15q, cfg15q, max_num_seqs=64, num_pages=160,
+                           page_size=128, max_seq_len=1024, prefill_chunk=256,
                            use_pallas=True, decode_burst=32, kv_quant=True,
                            prefill_priority=True, prefill_widths=2,
                            prefix_caching=True)
@@ -780,8 +784,10 @@ def _main() -> None:
 
     # ---- eval config #5 in its stated regime: 64 streams on 1.5B ---------
     if params15 is not None and budget_allows("concurrent64-1.5b", 180):
-        eng15c = Engine(params15, cfg15, max_num_seqs=64, num_pages=320,
-                        page_size=64, max_seq_len=1024, prefill_chunk=256,
+        # page_size=128 (r05 probe): 4337 agg vs 3812 at 64, equal TTFT —
+        # same exact-page-fill + halved-page-walk win as the 7B item
+        eng15c = Engine(params15, cfg15, max_num_seqs=64, num_pages=160,
+                        page_size=128, max_seq_len=1024, prefill_chunk=256,
                         use_pallas=True, decode_burst=32, prefill_widths=2)
         log("bench[64seq-1.5b]: warmup (compiles all row buckets)")
         eng15c.warmup()

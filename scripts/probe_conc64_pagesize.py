@@ -1,0 +1,52 @@
+"""Real-chip probe: page_size 64 vs 128 for the 0.5B / 1.5B conc64 items
+(the 7B item measured +11% agg and better TTFT at 128 — exact page fill
+for the 128-token prompts plus a halved Pallas page walk; see
+scripts/validate_conc64_7b.py and the bench item comment).
+
+Usage: python scripts/probe_conc64_pagesize.py [0.5b|1.5b|sd]
+"""
+import sys
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from githubrepostorag_tpu.models import init_params  # noqa: E402
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config  # noqa: E402
+from githubrepostorag_tpu.models.quant import (  # noqa: E402
+    fuse_projections,
+    init_params_quantized,
+)
+from githubrepostorag_tpu.serving.engine import Engine  # noqa: E402
+
+which = sys.argv[1] if len(sys.argv) > 1 else "0.5b"
+if which == "0.5b":
+    cfg = Qwen2Config.qwen2_0_5b()
+    params = fuse_projections(
+        init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16),
+        in_place=True)
+    kw = {}
+elif which == "1.5b":
+    cfg = Qwen2Config.qwen2_1_5b()
+    params = fuse_projections(
+        init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16),
+        in_place=True)
+    kw = {}
+else:  # served-default: 1.5B int8 + kv_quant + prefix cache + priority
+    cfg = Qwen2Config.qwen2_1_5b()
+    params = init_params_quantized(cfg, bits=8, fuse=True)
+    kw = dict(kv_quant=True, prefill_priority=True, prefix_caching=True)
+jax.block_until_ready(params)
+
+for page_size, num_pages in ((64, 320), (128, 160)):
+    eng = Engine(params, cfg, max_num_seqs=64, num_pages=num_pages,
+                 page_size=page_size, max_seq_len=1024, prefill_chunk=256,
+                 use_pallas=True, decode_burst=32, prefill_widths=2, **kw)
+    eng.warmup()
+    agg, p50, ph = bench.bench_concurrency(cfg, streams=64, prompt_len=128,
+                                           gen_tokens=128, engine=eng,
+                                           trials=3)
+    bench.log(f"probe[{which}]: page_size={page_size} -> median agg "
+              f"{agg:.1f} tok/s, p50 TTFT {p50:.3f}s ({ph['trial_aggs']})")
+    del eng
