@@ -3,7 +3,7 @@
 for the 128-token prompts plus a halved Pallas page walk; see
 scripts/validate_conc64_7b.py and the bench item comment).
 
-Usage: python scripts/probe_conc64_pagesize.py [0.5b|1.5b|sd]
+Usage: python scripts/probe_conc64_pagesize.py [0.5b|0.5b-kvq|1.5b|sd]
 """
 import sys
 
@@ -21,12 +21,12 @@ from githubrepostorag_tpu.models.quant import (  # noqa: E402
 from githubrepostorag_tpu.serving.engine import Engine  # noqa: E402
 
 which = sys.argv[1] if len(sys.argv) > 1 else "0.5b"
-if which == "0.5b":
+if which in ("0.5b", "0.5b-kvq"):
     cfg = Qwen2Config.qwen2_0_5b()
     params = fuse_projections(
         init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16),
         in_place=True)
-    kw = {}
+    kw = dict(kv_quant=True) if which == "0.5b-kvq" else {}
 elif which == "1.5b":
     cfg = Qwen2Config.qwen2_1_5b()
     params = fuse_projections(
