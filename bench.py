@@ -842,6 +842,55 @@ def _main() -> None:
             jax.block_until_ready(params05)
         return params05
 
+    # ---- MoE family decode (beyond-reference component, measured) --------
+    # Runs BEFORE the remaining 0.5B/kvquant/spec tail: the int8 MoE row is
+    # a VERDICT r04 target and must survive a slow driver day — under
+    # budget pressure the skips should land on the continuity items below.
+    # The Qwen2-MoE family (models/moe.py: GShard dispatch/combine, shared
+    # expert, ep-shardable) had parity tests but no perf line.  The real
+    # A2.7B geometry (14.3B params) cannot fit one 16 GB chip in bf16, so
+    # this measures a mid-scale 16-expert top-2 geometry (~2.3 GB): GShard's
+    # dense one-hot combine streams EVERY expert per step, so the roofline
+    # is the full tree — same accounting as the dense rows.
+    if budget_allows("moe-decode", 150):
+        cfg_moe = Qwen2Config(
+            vocab_size=151936, hidden_size=1024, intermediate_size=2816,
+            num_layers=12, num_heads=16, num_kv_heads=4, head_dim=64,
+            tie_word_embeddings=True, max_position_embeddings=4096,
+            num_experts=16, num_experts_per_tok=2, moe_intermediate_size=1408,
+            shared_expert_intermediate_size=2816, norm_topk_prob=True,
+        )
+        tps_moe, _, params_moe = bench_decode(
+            cfg_moe, "qwen2-moe-16e", batch=8, prompt_len=128, gen_tokens=256,
+            num_pages=64, page_size=256, max_seq=1024, decode_burst=128,
+            runs=2)
+        nbytes_moe = streamed_nbytes(params_moe)
+        emit("decode_tok_s_per_chip_qwen2-moe-16e_bs8", tps_moe, "tok/s",
+             tps_moe / BASELINE_TOK_S, **decode_extras(tps_moe, 8, nbytes_moe))
+        # ---- int8 MoE (VERDICT r04 next #4): the bf16 16-expert row sat a
+        # hair under the 2000 floor in r04 (1992.6, 68% of roofline);
+        # per-expert stacked-scale int8 (tested in test_moe.py) halves the
+        # streamed expert bytes — quantize the RESIDENT bf16 tree on device
+        if budget_allows("moe-int8-decode", 120):
+            from githubrepostorag_tpu.models.quant import quantize_qwen2_params
+
+            log("bench[qwen2-moe-16e-int8]: quantizing the resident tree on device")
+            params_moe_q = quantize_qwen2_params(params_moe)
+            jax.block_until_ready(params_moe_q)
+            del params_moe
+            gc.collect()
+            tps_moeq, _, _ = bench_decode(
+                cfg_moe, "qwen2-moe-16e-int8", batch=8, prompt_len=128,
+                gen_tokens=256, num_pages=64, page_size=256, max_seq=1024,
+                decode_burst=128, runs=2, params=params_moe_q)
+            emit("decode_tok_s_per_chip_qwen2-moe-16e_int8_bs8", tps_moeq,
+                 "tok/s", tps_moeq / BASELINE_TOK_S,
+                 **decode_extras(tps_moeq, 8, streamed_nbytes(params_moe_q)))
+            del params_moe_q
+        else:
+            del params_moe
+        gc.collect()
+
     # ---- int8 KV cache in its WINNING regime: equal-HBM capacity ---------
     # (VERDICT r03 #4a) pools sized to the SAME byte budget — bf16 160
     # pages vs int8 320 (+1/128 scales) — under a workload needing ~40k
@@ -1026,53 +1075,6 @@ def _main() -> None:
     if budget_allows("embed", 60):
         rate = bench_embedding(chunks=4096, seq_len=256, batch=256)
         emit("embed_chunks_s_e5-small", rate, "chunks/s", None)
-
-    # ---- MoE family decode (beyond-reference component, measured) --------
-    # The Qwen2-MoE family (models/moe.py: GShard dispatch/combine, shared
-    # expert, ep-shardable) had parity tests but no perf line.  The real
-    # A2.7B geometry (14.3B params) cannot fit one 16 GB chip in bf16, so
-    # this measures a mid-scale 16-expert top-2 geometry (~2.3 GB): GShard's
-    # dense one-hot combine streams EVERY expert per step, so the roofline
-    # is the full tree — same accounting as the dense rows.
-    if budget_allows("moe-decode", 150):
-        cfg_moe = Qwen2Config(
-            vocab_size=151936, hidden_size=1024, intermediate_size=2816,
-            num_layers=12, num_heads=16, num_kv_heads=4, head_dim=64,
-            tie_word_embeddings=True, max_position_embeddings=4096,
-            num_experts=16, num_experts_per_tok=2, moe_intermediate_size=1408,
-            shared_expert_intermediate_size=2816, norm_topk_prob=True,
-        )
-        tps_moe, _, params_moe = bench_decode(
-            cfg_moe, "qwen2-moe-16e", batch=8, prompt_len=128, gen_tokens=256,
-            num_pages=64, page_size=256, max_seq=1024, decode_burst=128,
-            runs=2)
-        nbytes_moe = streamed_nbytes(params_moe)
-        emit("decode_tok_s_per_chip_qwen2-moe-16e_bs8", tps_moe, "tok/s",
-             tps_moe / BASELINE_TOK_S, **decode_extras(tps_moe, 8, nbytes_moe))
-        # ---- int8 MoE (VERDICT r04 next #4): the bf16 16-expert row sat a
-        # hair under the 2000 floor (1992.6, 68% of roofline); per-expert
-        # stacked-scale int8 (tested in test_moe.py) halves the streamed
-        # expert bytes — quantize the RESIDENT bf16 tree on device
-        if budget_allows("moe-int8-decode", 120):
-            from githubrepostorag_tpu.models.quant import quantize_qwen2_params
-
-            log("bench[qwen2-moe-16e-int8]: quantizing the resident tree on device")
-            params_moe_q = quantize_qwen2_params(params_moe)
-            jax.block_until_ready(params_moe_q)
-            del params_moe
-            gc.collect()
-            tps_moeq, _, _ = bench_decode(
-                cfg_moe, "qwen2-moe-16e-int8", batch=8, prompt_len=128,
-                gen_tokens=256, num_pages=64, page_size=256, max_seq=1024,
-                decode_burst=128, runs=2, params=params_moe_q)
-            emit("decode_tok_s_per_chip_qwen2-moe-16e_int8_bs8", tps_moeq,
-                 "tok/s", tps_moeq / BASELINE_TOK_S,
-                 **decode_extras(tps_moeq, 8, streamed_nbytes(params_moe_q)))
-            del params_moe_q
-        else:
-            del params_moe
-        gc.collect()
-
 
 
 if __name__ == "__main__":
