@@ -482,14 +482,22 @@ def bench_spec_decode_rag(cfg0) -> dict:
         eng.generate([rag_prompt(900)], sp)  # warm: compiles both row shapes
         eng.generate([rag_prompt(901 + i) for i in range(4)], sp)
         for bs in (1, 4):
-            p0, a0 = getattr(eng, "spec_proposed", 0), getattr(eng, "spec_accepted", 0)
-            t0 = time.monotonic()
-            res = eng.generate([rag_prompt(1000 + 10 * bs + i) for i in range(bs)], sp)
-            out[f"{tag}_bs{bs}"] = time.monotonic() - t0
-            assert all(len(r.output_tokens) == gen for r in res)
-            if spec:
-                acc_prop += eng.spec_proposed - p0
-                acc_acc += eng.spec_accepted - a0
+            walls = []
+            for rep in range(3):  # median of 3: each cell is a 1-3 s wall
+                # and feeds a README ratio — fresh prompts per rep
+                p0 = getattr(eng, "spec_proposed", 0)
+                a0 = getattr(eng, "spec_accepted", 0)
+                prompts = [rag_prompt(1000 + 100 * bs + 10 * rep + i)
+                           for i in range(bs)]
+                t0 = time.monotonic()
+                res = eng.generate(prompts, sp)
+                walls.append(time.monotonic() - t0)
+                assert all(len(r.output_tokens) == gen for r in res)
+                if spec:
+                    acc_prop += eng.spec_proposed - p0
+                    acc_acc += eng.spec_accepted - a0
+            walls.sort()
+            out[f"{tag}_bs{bs}"] = walls[1]
         del eng
         gc.collect()
     out["acceptance"] = acc_acc / max(acc_prop, 1)
@@ -514,13 +522,19 @@ def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
     out = enc.embed(params, cfg, ids, mask)
     jax.block_until_ready(out)  # compile
     n_batches = max(1, chunks // batch)
-    t0 = time.monotonic()
-    for _ in range(n_batches):
-        out = enc.embed(params, cfg, ids, mask)
-    jax.block_until_ready(out)
-    wall = time.monotonic() - t0
+    walls = []
+    for _ in range(3):  # median of 3 timed regions: the region is ~1 s,
+        # so a single tunnel stall would otherwise own the metric
+        t0 = time.monotonic()
+        for _ in range(n_batches):
+            out = enc.embed(params, cfg, ids, mask)
+        jax.block_until_ready(out)
+        walls.append(time.monotonic() - t0)
+    walls.sort()
+    wall = walls[1]
     rate = n_batches * batch / wall
-    log(f"bench[embed]: {n_batches * batch} chunks x {seq_len} toks in {wall:.2f}s "
+    log(f"bench[embed]: {n_batches * batch} chunks x {seq_len} toks in "
+        f"{wall:.2f}s (median of {[round(w, 2) for w in walls]}) "
         f"-> {rate:.0f} chunks/s")
     return rate
 
