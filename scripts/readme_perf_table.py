@@ -30,12 +30,19 @@ START = "<!-- PERF_TABLE_START"
 END = "<!-- PERF_TABLE_END -->"
 
 
-def load_driver_summary(root: pathlib.Path = ROOT) -> tuple[str, dict[str, float]]:
-    """Parse ``{"bench_summary": {...}}`` out of the newest BENCH_r0N.json
-    tail.  The driver keeps only the last ~2000 chars of bench output, so
-    the line may be truncated at the FRONT — recover per-metric pairs by
-    regex inside the summary object instead of requiring valid JSON."""
-    for path in sorted(root.glob("BENCH_r[0-9]*.json"), reverse=True):
+def load_driver_summary(root: pathlib.Path = ROOT,
+                        name: str | None = None) -> tuple[str, dict[str, float]]:
+    """Parse ``{"bench_summary": {...}}`` out of a BENCH_r0N.json tail —
+    the newest by default, or exactly ``name`` when pinned (the drift gate
+    pins to the artifact the committed README was generated from, so a
+    NEWER driver artifact landing between rounds doesn't fail CI — see
+    tests/test_readme_table.py).  The driver keeps only the last ~2000
+    chars of bench output, so the line may be truncated at the FRONT —
+    recover per-metric pairs by regex inside the summary object instead of
+    requiring valid JSON."""
+    candidates = ([root / name] if name else
+                  sorted(root.glob("BENCH_r[0-9]*.json"), reverse=True))
+    for path in candidates:
         try:
             tail = json.loads(path.read_text()).get("tail", "")
         except (OSError, json.JSONDecodeError):
@@ -142,10 +149,29 @@ def build_table(records: list[dict], driver_name: str,
     return "\n".join([head] + [r for r in rows if r] + [END])
 
 
-def render(root: pathlib.Path = ROOT) -> str:
+def render(root: pathlib.Path = ROOT, driver_name: str | None = None) -> str:
+    """``driver_name``: None = newest artifact (a fresh regeneration);
+    "BENCH_r0N.json" = pin to that artifact; "" = render the no-driver
+    table (a README committed when no artifact tail parsed)."""
     data = json.loads((root / "BENCH_SUMMARY.json").read_text())
-    driver_name, driver = load_driver_summary(root)
-    return build_table(data["records"], driver_name, driver)
+    if driver_name == "":
+        name, driver = "", {}
+    else:
+        name, driver = load_driver_summary(root, name=driver_name)
+    return build_table(data["records"], name, driver)
+
+
+def committed_driver_name(table_text: str) -> str | None:
+    """The driver artifact a generated TABLE BLOCK was built from, parsed
+    out of its column header (pass the extracted block, not the whole
+    README — prose elsewhere could echo a header line).  Returns the
+    artifact name, or "" when the header says ``Driver run (none)`` (the
+    gate must then pin to the no-driver rendering, NOT fall back to the
+    newest artifact), or None when no header is present at all."""
+    m = re.search(r"\| Driver run \((BENCH_r[0-9]+\.json)\)", table_text)
+    if m:
+        return m.group(1)
+    return "" if re.search(r"\| Driver run \(none\)", table_text) else None
 
 
 def main() -> int:
