@@ -131,8 +131,18 @@ class Settings:
     # --- TPU / parallelism ---
     mesh_shape: str = field(default_factory=lambda: os.getenv("MESH_SHAPE", ""))  # e.g. "dp:2,tp:4"
     dtype: str = field(default_factory=lambda: os.getenv("MODEL_DTYPE", "bfloat16"))
-    kv_page_size: int = field(default_factory=lambda: _env_int("KV_PAGE_SIZE", 16))
-    kv_num_pages: int = field(default_factory=lambda: _env_int("KV_NUM_PAGES", 2048))
+    # page_size x num_pages = KV token capacity (default 32k slots).
+    # 128-token pages measured +11-29% conc64 THROUGHPUT over 64-token
+    # pages on 128-token prompts, kv_quant included (BENCH r05,
+    # scripts/probe_conc64_pagesize.py).  Two granularity tradeoffs ride
+    # the same knob: prefix caching shares WHOLE pages, so shared
+    # prefixes shorter than one page stop caching; and with KV_QUANT=1 a
+    # page's int8 scale is fixed by its first write, so up to
+    # page_size-1 later appends clip against it (greedy still tracks
+    # bf16 >= 32 tokens deep at 128 — test_kv_quant).  Match page size
+    # to min(typical prompt, shared-prefix length) — helm kvPageSize.
+    kv_page_size: int = field(default_factory=lambda: _env_int("KV_PAGE_SIZE", 128))
+    kv_num_pages: int = field(default_factory=lambda: _env_int("KV_NUM_PAGES", 256))
     max_num_seqs: int = field(default_factory=lambda: _env_int("MAX_NUM_SEQS", 64))
     prefill_chunk: int = field(default_factory=lambda: _env_int("PREFILL_CHUNK", 512))
     # number of power-of-two prefill dispatch widths (chunk, chunk/2, ...)

@@ -78,6 +78,26 @@ def test_engine_kv_quant_tracks_full_precision(tiny):
         assert sum(a != b for a, b in zip(r, g)) <= 2, (r, g)
 
 
+def test_engine_kv_quant_tracks_full_precision_at_page_128(tiny):
+    """The production default page size (config.py KV_PAGE_SIZE=128)
+    widens the first-write scale window: up to 127 decode appends into a
+    page reuse the scale its OPENING write fixed (quantize_kv_paged),
+    clipping any later outlier — the accuracy case the r05 throughput
+    probes never measured.  Greedy decode must track the bf16 engine
+    deep into a page full of first-write-scaled appends."""
+    cfg, params = tiny
+    geom = dict(num_pages=4, page_size=128, max_seq_len=256)
+    sp = SamplingParams(max_tokens=100, temperature=0.0, stop_token_ids=())
+    prompts = [[1, 2, 3, 4, 5]]
+    ref = _engine(params, cfg, **geom).generate(prompts, sp)[0].output_tokens
+    got = _engine(params, cfg, kv_quant=True, **geom).generate(prompts, sp)[0].output_tokens
+    # first divergence (tiny random weights have near-tie logit gaps, so a
+    # single late flip cascades — count faithful PREFIX length, not flips)
+    first_diff = next((i for i, (a, b) in enumerate(zip(ref, got)) if a != b),
+                      len(ref))
+    assert first_diff >= 32, (first_diff, ref, got)
+
+
 def test_kv_quant_composes_with_prefix_cache(tiny):
     """A warm request resuming from int8 cached pages must produce the
     cold request's tokens — the page content is the quantized
