@@ -14,6 +14,12 @@ test: native
 test-fast:
 	python -m pytest tests/ -q -m "not slow"
 
+# tpulint: in-tree static analysis for JAX trace-safety, host-sync, and
+# async-race hazards (fails on any unsuppressed finding; fixtures under
+# tests/lint_fixtures are the rule corpus, not production code)
+lint:
+	python -m tools.tpulint githubrepostorag_tpu tests --exclude tests/lint_fixtures
+
 bench:
 	python bench.py
 

@@ -49,13 +49,15 @@ class RespConnection:
             await self.command("SELECT", self.db)
 
     async def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
+        # capture-and-clear before awaiting: a second close() racing past
+        # wait_closed() must find None, not a half-torn-down writer
+        writer, self._reader, self._writer = self._writer, None, None
+        if writer is not None:
+            writer.close()
             try:
-                await self._writer.wait_closed()
+                await writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
-            self._reader = self._writer = None
 
     @property
     def connected(self) -> bool:
@@ -72,10 +74,11 @@ class RespConnection:
 
     async def send(self, *args: str | bytes | int | float) -> None:
         """Send without reading a reply (subscribe-mode writes)."""
-        if not self.connected:
-            await self.connect()
-        self._writer.write(_encode_command(*args))
-        await self._writer.drain()
+        async with self._lock:
+            if not self.connected:
+                await self.connect()
+            self._writer.write(_encode_command(*args))
+            await self._writer.drain()
 
     async def read_reply(self):
         line = await self._reader.readline()

@@ -82,9 +82,11 @@ class OpenAIServer:
         return bound
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        # capture-and-clear before awaiting: two concurrent stop() calls must
+        # not both see the runner and double-cleanup it
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()
         await self.engine.stop()
 
     # ------------------------------------------------------------ handlers

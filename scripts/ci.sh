@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# One CI entrypoint: static analysis first (cheap, catches the perf/race
+# hazards pytest can't see), then the tier-1 test suite from ROADMAP.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tpulint =="
+make lint
+
+echo "== tier-1 tests =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -1,0 +1,22 @@
+"""ASY001 negative: async equivalents and executor hand-off."""
+import asyncio
+import time
+
+import requests
+
+
+async def poll_backend(url):
+    await asyncio.sleep(1.0)
+    loop = asyncio.get_running_loop()
+
+    def fetch():
+        # nested sync def is shipped to the executor — blocking is fine here
+        time.sleep(0.01)
+        return requests.get(url, timeout=5)
+
+    return await loop.run_in_executor(None, fetch)
+
+
+def sync_probe(url):
+    time.sleep(0.1)  # not async: blocking is the caller's problem
+    return requests.get(url, timeout=5)

@@ -1,0 +1,12 @@
+"""ASY001 positive: blocking calls parked on the event loop."""
+import subprocess
+import time
+
+import requests
+
+
+async def poll_backend(url):
+    time.sleep(1.0)  # freezes every coroutine on the loop
+    resp = requests.get(url, timeout=5)  # sync HTTP on the loop
+    subprocess.run(["true"], check=True)  # sync child process on the loop
+    return resp

@@ -1,0 +1,42 @@
+"""ASY002 negative: lock-held spans and capture-and-clear are safe."""
+import asyncio
+
+
+class Scheduler:
+    def __init__(self):
+        self.pending = 0
+        self.conn = None
+        self._lock = asyncio.Lock()
+
+    async def admit(self, batch):
+        async with self._lock:
+            count = self.pending
+            placed = await self.place(batch)
+            self.pending = count + placed  # lock held across the await
+
+    async def place(self, batch):
+        return len(batch)
+
+    async def close(self):
+        conn, self.conn = self.conn, None  # capture-and-clear before await
+        if conn is not None:
+            await conn.wait_closed()
+
+
+class Client:
+    def __init__(self):
+        self.conn = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self):
+        self.conn = await open_conn()
+
+    async def send(self, data):
+        async with self._lock:
+            if self.conn is None:
+                await self.connect()
+            self.conn.write(data)
+
+
+async def open_conn():
+    return None

@@ -1,0 +1,35 @@
+"""ASY002 positive: scheduler state mutated across an await, lock-free."""
+
+
+class Scheduler:
+    def __init__(self):
+        self.pending = 0
+        self.conn = None
+
+    async def admit(self, batch):
+        count = self.pending  # read ...
+        placed = await self.place(batch)  # ... loop yields: others interleave
+        self.pending = count + placed  # ... write: lost-update race
+
+    async def place(self, batch):
+        return len(batch)
+
+    async def bump(self):
+        self.pending += await self.place([1])  # read+await+write in one stmt
+
+
+class Client:
+    def __init__(self):
+        self.conn = None
+
+    async def connect(self):
+        self.conn = await open_conn()
+
+    async def send(self, data):
+        if self.conn is None:  # check ...
+            await self.connect()  # ... then act: double-connect race
+        self.conn.write(data)
+
+
+async def open_conn():
+    return None

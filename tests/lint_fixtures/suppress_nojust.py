@@ -1,0 +1,6 @@
+"""Suppression without a justification: finding stays, LNT000 is added."""
+import time
+
+
+async def shutdown_grace():
+    time.sleep(0.05)  # tpulint: disable=ASY001

@@ -1,0 +1,6 @@
+"""Suppression naming a rule that does not exist: LNT001."""
+import time
+
+
+async def shutdown_grace():
+    time.sleep(0.05)  # tpulint: disable=NOPE999 -- typo'd rule id

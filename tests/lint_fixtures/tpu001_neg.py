@@ -1,0 +1,23 @@
+"""TPU001 negative: static branching and shape inspection are trace-safe."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def branch_on_static(x, flag):
+    if flag:  # static arg — resolved at trace time
+        return x * 2
+    return x
+
+
+@jax.jit
+def branch_on_shape(x, scales=None):
+    if x.ndim == 2:  # shapes are trace-time constants
+        x = x[None]
+    if x.shape[0] > 1:
+        x = x[:1]
+    if scales is None:  # pytree-structure dispatch, not a traced value
+        return x
+    return jnp.where(x > 0, x, -x)  # traced branch done the right way

@@ -1,0 +1,26 @@
+"""TPU001 positive: Python control flow on traced values inside jit."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_traced(x):
+    if x > 0:  # traced comparison concretized by `if`
+        return x * 2
+    return x
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def loop_on_traced(x, flag):
+    while x < 10:  # traced value drives a Python while
+        x = x + 1
+    return x
+
+
+@jax.jit
+def concretize(x):
+    a = float(x)  # host sync
+    b = x.item()  # host sync
+    return a + b + bool(x)

@@ -1,0 +1,14 @@
+"""TPU002 negative: jnp inside jit; np outside jit is fine."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def device_math(x):
+    return jnp.sum(jnp.asarray(x))
+
+
+def host_prep(batch):
+    # not jitted: numpy staging on the host is exactly where np belongs
+    return np.asarray(batch, dtype=np.int32)

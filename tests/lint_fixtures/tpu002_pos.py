@@ -1,0 +1,9 @@
+"""TPU002 positive: numpy executed inside a jitted function."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def host_math(x):
+    y = np.asarray(x)  # device -> host transfer at trace time
+    return np.sum(y)  # host-side reduction baked into the trace

@@ -1,0 +1,23 @@
+"""TPU003 negative: shapes declared static, call sites bucketed."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def next_bucket(n):
+    return max(8, 1 << (n - 1).bit_length())
+
+
+@partial(jax.jit, static_argnames=("n",))
+def make_buffer(x, n):
+    return x + jnp.zeros(n)  # static shape arg — one compile per bucket
+
+
+@jax.jit
+def from_own_shape(x):
+    return x.reshape(x.shape[0], -1)  # shapes of traced args are static
+
+
+def caller(x, tokens):
+    return make_buffer(x, next_bucket(len(tokens)))

@@ -1,0 +1,18 @@
+"""TPU003 positive: shape-varying Python scalars cross the jit boundary."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def make_buffer(n):
+    return jnp.zeros(n)  # traced param used as a shape
+
+
+@jax.jit
+def regrid(x, rows):
+    return x.reshape(rows, -1)  # traced param in reshape
+
+
+def caller(tokens, pad_batch):
+    # len() straight into a jitted callable: recompiles per distinct length
+    return make_buffer(len(tokens))

@@ -1,0 +1,22 @@
+"""TPU004 negative: keys split before every consumption."""
+import jax
+
+
+def double_sample(key, shape):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a + b
+
+
+def loop_sample(key, steps):
+    out = []
+    for _ in range(steps):
+        key, sub = jax.random.split(key)  # re-bound inside the loop
+        out.append(jax.random.normal(sub, ()))
+    return out
+
+
+def chain(key, shape):
+    key = jax.random.split(key, 2)[0]
+    return jax.random.normal(key, shape)  # key re-bound between uses

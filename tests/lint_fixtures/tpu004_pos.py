@@ -1,0 +1,15 @@
+"""TPU004 positive: PRNG key reuse."""
+import jax
+
+
+def double_sample(key, shape):
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # same key: correlated "randomness"
+    return a + b
+
+
+def loop_sample(key, steps):
+    out = []
+    for _ in range(steps):
+        out.append(jax.random.normal(key, ()))  # identical draw every iter
+    return out

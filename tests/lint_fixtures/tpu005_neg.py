@@ -1,0 +1,17 @@
+"""TPU005 negative: syncs confined to warmup/bench helpers are fine."""
+import jax
+
+
+def warmup(state, tokens):
+    # not a step/decode/prefill path: timing and warmup may sync freely
+    out = run_model(state, tokens)
+    out.block_until_ready()
+    return jax.device_get(out)
+
+
+def decode_step(state, tokens):
+    return run_model(state, tokens)  # async dispatch, no sync
+
+
+def run_model(state, tokens):
+    return tokens

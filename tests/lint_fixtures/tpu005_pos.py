@@ -1,0 +1,13 @@
+"""TPU005 positive: device syncs inside step/decode-named hot paths."""
+import jax
+
+
+def decode_step(state, tokens):
+    out = run_model(state, tokens)
+    out.block_until_ready()  # serializes TPU against the Python driver
+    host = jax.device_get(out)  # synchronous device -> host copy
+    return host
+
+
+def run_model(state, tokens):
+    return tokens

@@ -1,0 +1,14 @@
+"""TPU006 negative: the donated name is rebound by the call's result."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(kv_pages, delta):
+    return kv_pages + delta
+
+
+def step(kv_pages, delta):
+    kv_pages = update(kv_pages, delta)  # rebind over the donated buffer
+    return kv_pages.sum()

@@ -1,0 +1,14 @@
+"""TPU006 positive: donated buffer read after the jitted call."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(kv_pages, delta):
+    return kv_pages + delta
+
+
+def step(kv_pages, delta):
+    new_pages = update(kv_pages, delta)
+    return kv_pages.sum() + new_pages  # kv_pages was donated: invalid read

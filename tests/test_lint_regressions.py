@@ -1,0 +1,108 @@
+"""Regression tests for the races tpulint (ASY002) surfaced in-tree.
+
+Each test drives two concurrent tasks through the span that used to
+read-check shared state, await, then act on it — and asserts the
+interleaving can no longer double-fire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from githubrepostorag_tpu.api.app import build_app
+from githubrepostorag_tpu.events.resp import RespConnection
+
+
+class _FakeRunner:
+    """Stands in for web.AppRunner: records cleanup calls and yields the
+    loop mid-cleanup to give a second stop() the chance to interleave."""
+
+    def __init__(self):
+        self.cleanups = 0
+
+    async def cleanup(self):
+        self.cleanups += 1
+        await asyncio.sleep(0.01)
+
+
+async def test_ragapi_concurrent_stop_cleans_up_once():
+    api = build_app()
+    runner = _FakeRunner()
+    api._runner = runner
+    await asyncio.gather(api.stop(), api.stop())
+    assert runner.cleanups == 1
+    assert api._runner is None
+
+
+async def test_openai_server_concurrent_stop_cleans_up_once():
+    from githubrepostorag_tpu.serving.openai_api import OpenAIServer
+
+    class _FakeEngine:
+        def __init__(self):
+            self.stops = 0
+
+        async def stop(self):
+            self.stops += 1
+
+    server = OpenAIServer.__new__(OpenAIServer)  # skip engine/tokenizer wiring
+    server.engine = _FakeEngine()
+    runner = _FakeRunner()
+    server._runner = runner
+    await asyncio.gather(server.stop(), server.stop())
+    assert runner.cleanups == 1
+    assert server._runner is None
+
+
+class _FakeWriter:
+    def __init__(self):
+        self.closed = 0
+        self.waited = 0
+        self.sent: list[bytes] = []
+
+    def close(self):
+        self.closed += 1
+
+    async def wait_closed(self):
+        self.waited += 1
+        await asyncio.sleep(0.01)
+
+    def write(self, data: bytes):
+        self.sent.append(data)
+
+    async def drain(self):
+        await asyncio.sleep(0)
+
+    def is_closing(self):
+        return False
+
+
+async def test_resp_concurrent_close_tears_down_once():
+    conn = RespConnection("redis://localhost:6379/0")
+    writer = _FakeWriter()
+    conn._writer = writer
+    conn._reader = object()
+    # the second close used to re-enter with a half-torn-down writer and
+    # call close()/wait_closed() on it again (or on None)
+    await asyncio.gather(conn.close(), conn.close())
+    assert writer.closed == 1
+    assert writer.waited == 1
+    assert conn._writer is None and conn._reader is None
+
+
+async def test_resp_concurrent_send_connects_once():
+    conn = RespConnection("redis://localhost:6379/0")
+    connects = 0
+
+    async def fake_connect():
+        nonlocal connects
+        connects += 1
+        await asyncio.sleep(0.01)  # yield so the other send can interleave
+        conn._reader = object()
+        conn._writer = _FakeWriter()
+
+    conn.connect = fake_connect  # type: ignore[method-assign]
+    await asyncio.gather(conn.send("PING"), conn.send("PING"))
+    # without the lock both sends saw `not self.connected` and both opened a
+    # connection, clobbering each other's reader/writer pair
+    assert connects == 1
+    assert len(conn._writer.sent) == 2

@@ -1,0 +1,167 @@
+"""tpulint's own test suite: every rule has a firing positive fixture and a
+silent negative fixture, suppressions need justifications, the JSON reporter
+keeps its schema, and the production tree itself stays lint-clean."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.tpulint.core import (  # noqa: E402
+    RULE_NO_JUSTIFICATION,
+    RULE_PARSE_ERROR,
+    RULE_UNKNOWN_RULE,
+    analyze_file,
+    analyze_source,
+    iter_py_files,
+    run_paths,
+)
+from tools.tpulint.reporters import render_json, render_rule_list, render_text  # noqa: E402
+from tools.tpulint.rules import RULES  # noqa: E402
+
+FIXTURES = REPO / "tests" / "lint_fixtures"
+RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "ASY001", "ASY002"]
+
+
+# ------------------------------------------------------------------ registry
+
+def test_registry_has_the_documented_rule_set():
+    assert sorted(RULES) == sorted(RULE_IDS)
+
+
+def test_list_rules_mentions_every_id():
+    listing = render_rule_list()
+    for rule_id in RULE_IDS:
+        assert rule_id in listing
+
+
+# ------------------------------------------------------------ fixture corpus
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_positive_fixture_fires(rule_id):
+    findings = analyze_file(FIXTURES / f"{rule_id.lower()}_pos.py")
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, f"{rule_id} did not fire on its positive fixture"
+    assert all(not f.suppressed for f in hits)
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_negative_fixture_is_silent(rule_id):
+    findings = analyze_file(FIXTURES / f"{rule_id.lower()}_neg.py")
+    assert [f for f in findings if f.rule == rule_id] == []
+
+
+def test_negative_fixtures_are_fully_clean():
+    # negatives must not trip OTHER rules either, or the corpus is confusing
+    for neg in sorted(FIXTURES.glob("*_neg.py")):
+        findings = analyze_file(neg)
+        assert findings == [], f"{neg.name}: {[f.rule for f in findings]}"
+
+
+# -------------------------------------------------------------- suppressions
+
+def test_justified_suppression_silences_and_records_reason():
+    findings = analyze_file(FIXTURES / "suppress_ok.py")
+    assert findings, "fixture should produce (suppressed) findings"
+    assert all(f.suppressed for f in findings)
+    assert all(f.justification for f in findings)
+
+
+def test_suppression_without_justification_keeps_finding_and_adds_lnt000():
+    findings = analyze_file(FIXTURES / "suppress_nojust.py")
+    rules = {f.rule for f in findings}
+    assert RULE_NO_JUSTIFICATION in rules
+    asy = [f for f in findings if f.rule == "ASY001"]
+    assert asy and not asy[0].suppressed
+
+
+def test_unknown_rule_in_suppression_is_reported():
+    findings = analyze_file(FIXTURES / "suppress_unknown.py")
+    assert RULE_UNKNOWN_RULE in {f.rule for f in findings}
+
+
+def test_directive_inside_string_literal_is_ignored():
+    src = 'MSG = "# tpulint: disable=ASY001 -- not a real comment"\n'
+    assert analyze_source(src, "s.py") == []
+
+
+def test_parse_error_becomes_a_finding_not_a_crash():
+    findings = analyze_source("def broken(:\n", "broken.py")
+    assert [f.rule for f in findings] == [RULE_PARSE_ERROR]
+
+
+# ----------------------------------------------------------------- reporters
+
+def test_json_reporter_schema():
+    findings, stats = run_paths([FIXTURES / "asy001_pos.py"])
+    payload = json.loads(render_json(findings, stats))
+    assert payload["version"] == 1
+    assert set(payload["stats"]) == {"files", "findings", "unsuppressed", "suppressed"}
+    assert payload["stats"]["files"] == 1
+    assert payload["stats"]["unsuppressed"] == len(payload["findings"]) > 0
+    for entry in payload["findings"]:
+        assert set(entry) == {"path", "line", "col", "rule", "message", "suppressed", "justification"}
+        assert entry["rule"] in RULE_IDS
+    assert set(payload["rules"]) == set(RULE_IDS)
+
+
+def test_text_reporter_lists_location_and_rule():
+    findings, stats = run_paths([FIXTURES / "tpu001_pos.py"])
+    text = render_text(findings, stats)
+    assert "tpu001_pos.py" in text and "TPU001" in text
+    assert "finding(s)" in text.splitlines()[-1]
+
+
+# ----------------------------------------------------------------- discovery
+
+def test_iter_py_files_exclude():
+    all_files = list(iter_py_files([FIXTURES]))
+    assert any(p.name == "tpu001_pos.py" for p in all_files)
+    none = list(iter_py_files([FIXTURES], excludes=["lint_fixtures"]))
+    assert none == []
+
+
+# ----------------------------------------------------------------------- CLI
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_exit_codes():
+    assert _run_cli("tests/lint_fixtures/tpu001_pos.py").returncode == 1
+    assert _run_cli("tests/lint_fixtures/tpu001_neg.py").returncode == 0
+    assert _run_cli().returncode == 2  # no paths
+
+
+def test_cli_json_output_parses():
+    proc = _run_cli("tests/lint_fixtures/tpu006_pos.py", "--format", "json")
+    payload = json.loads(proc.stdout)
+    assert payload["findings"][0]["rule"] == "TPU006"
+
+
+# ---------------------------------------------------- the tree stays clean
+
+def test_production_tree_has_zero_unsuppressed_findings():
+    """The same gate `make lint` enforces, kept inside tier-1 so a finding
+    fails CI even when only pytest runs."""
+    findings, stats = run_paths(
+        [REPO / "githubrepostorag_tpu", REPO / "tests"],
+        excludes=["tests/lint_fixtures"],
+    )
+    unsuppressed = [f for f in findings if not f.suppressed]
+    assert unsuppressed == [], [f"{f.location()} {f.rule} {f.message}" for f in unsuppressed]
+    # and every suppression that does exist must carry a justification
+    for f in findings:
+        if f.suppressed:
+            assert f.justification

@@ -1,0 +1,23 @@
+"""tpulint — in-tree static analysis for JAX trace-safety, host-sync, and
+async-race hazards.
+
+The hazards that destroy TPU serving numbers (recompilation from
+Python-varying shapes, implicit host syncs in the decode loop, blocking
+calls inside the async engine, racy mutation of scheduler state across
+``await``) change *performance or interleaving*, not single-threaded CPU
+results — pytest can't see them.  tpulint catches them at review time with
+a pure-stdlib ``ast`` pass.
+
+Usage:  python -m tools.tpulint githubrepostorag_tpu tests
+Rules:  python -m tools.tpulint --list-rules
+Suppression:  # tpulint: disable=RULE -- justification
+"""
+
+from __future__ import annotations
+
+from tools.tpulint.core import Finding, analyze_file, iter_py_files, run_paths
+from tools.tpulint.rules import RULES
+
+__version__ = "0.1.0"
+
+__all__ = ["Finding", "RULES", "analyze_file", "iter_py_files", "run_paths", "__version__"]

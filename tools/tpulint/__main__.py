@@ -1,0 +1,55 @@
+"""CLI: ``python -m tools.tpulint [paths...]``.
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.tpulint.core import run_paths
+from tools.tpulint.reporters import render_json, render_rule_list, render_text
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpulint",
+        description="Static analysis for JAX trace-safety, host-sync, and "
+        "async-race hazards. Suppress a finding with "
+        "`# tpulint: disable=RULE -- justification`.",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to analyze")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--exclude", action="append", default=[],
+        help="skip paths containing this substring (repeatable)",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings (text format)",
+    )
+    parser.add_argument("--list-rules", action="store_true", help="print the rule set and exit")
+    args = parser.parse_args(argv)
+
+    try:
+        if args.list_rules:
+            print(render_rule_list())
+            return 0
+        if not args.paths:
+            parser.print_usage(sys.stderr)
+            print("tpulint: error: no paths given", file=sys.stderr)
+            return 2
+
+        findings, stats = run_paths(args.paths, args.exclude)
+        if args.format == "json":
+            print(render_json(findings, stats))
+        else:
+            print(render_text(findings, stats, show_suppressed=args.show_suppressed))
+        return 1 if stats["unsuppressed"] else 0
+    except BrokenPipeError:  # output piped into head/less that exited
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,177 @@
+"""Driver: file discovery, per-file rule pipeline, suppression comments.
+
+Suppression grammar (one comment, same line as the finding or alone on the
+line above it):
+
+    # tpulint: disable=TPU001 -- justification text
+    # tpulint: disable=ASY001,ASY002 -- why this is safe here
+
+The justification is mandatory: a bare ``disable=RULE`` is itself reported
+(LNT000) so silenced findings stay auditable.  Unknown rule ids in a
+directive are reported as LNT001.  Files that fail to parse are reported as
+LNT100 rather than crashing the run.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from tools.tpulint.rules import RULES, FileContext
+
+# meta-rule ids (not suppressible findings about findings)
+RULE_NO_JUSTIFICATION = "LNT000"
+RULE_UNKNOWN_RULE = "LNT001"
+RULE_PARSE_ERROR = "LNT100"
+
+_DIRECTIVE_RE = re.compile(
+    r"#\s*tpulint:\s*disable=(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+    r"(?P<rest>.*)$"
+)
+_JUSTIFICATION_STRIP = re.compile(r"^[\s:—–-]+")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    suppressed: bool = False
+    justification: str | None = None
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+@dataclass
+class Suppression:
+    directive_line: int
+    target_line: int
+    rules: tuple[str, ...]
+    justification: str
+    used: bool = field(default=False)
+
+
+def _parse_suppressions(source: str, path: str) -> tuple[list[Suppression], list[Finding]]:
+    """Extract directives from real COMMENT tokens (never string literals)."""
+    suppressions: list[Suppression] = []
+    meta: list[Finding] = []
+    lines = source.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return suppressions, meta  # parse errors are reported separately
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DIRECTIVE_RE.search(tok.string)
+        if m is None:
+            continue
+        line = tok.start[0]
+        rules = tuple(r.strip() for r in m.group("rules").split(","))
+        justification = _JUSTIFICATION_STRIP.sub("", m.group("rest")).strip()
+        # a comment-only line shields the next non-blank, non-comment line
+        own_line = lines[line - 1].strip() if line <= len(lines) else ""
+        target = line
+        if own_line.startswith("#"):
+            target = line + 1
+            while target <= len(lines):
+                stripped = lines[target - 1].strip()
+                if stripped and not stripped.startswith("#"):
+                    break
+                target += 1
+        for rule_id in rules:
+            if rule_id not in RULES:
+                meta.append(Finding(
+                    path, line, tok.start[1], RULE_UNKNOWN_RULE,
+                    f"suppression names unknown rule {rule_id!r}",
+                ))
+        if not justification:
+            meta.append(Finding(
+                path, line, tok.start[1], RULE_NO_JUSTIFICATION,
+                "suppression is missing a justification "
+                "(write `# tpulint: disable=RULE -- why this is safe`)",
+            ))
+        suppressions.append(Suppression(line, target, rules, justification))
+    return suppressions, meta
+
+
+def analyze_source(source: str, path: str) -> list[Finding]:
+    """Run every rule over one file's source; apply suppressions."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 1, exc.offset or 0, RULE_PARSE_ERROR,
+                        f"file does not parse: {exc.msg}")]
+    ctx = FileContext(path=path, source=source, tree=tree)
+    findings: list[Finding] = []
+    for rule in RULES.values():
+        for line, col, message in rule.check(ctx):
+            findings.append(Finding(path, line, col, rule.id, message))
+
+    suppressions, meta = _parse_suppressions(source, path)
+    by_line: dict[int, list[Suppression]] = {}
+    for sup in suppressions:
+        by_line.setdefault(sup.target_line, []).append(sup)
+    for f in findings:
+        for sup in by_line.get(f.line, ()):
+            if f.rule in sup.rules and sup.justification:
+                f.suppressed = True
+                f.justification = sup.justification
+                sup.used = True
+    findings.extend(meta)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_file(path: Path, display_path: str | None = None) -> list[Finding]:
+    source = path.read_text(encoding="utf-8", errors="replace")
+    return analyze_source(source, display_path or str(path))
+
+
+def iter_py_files(paths: Iterable[str | Path], excludes: Iterable[str] = ()) -> Iterator[Path]:
+    excludes = tuple(str(e).replace("\\", "/") for e in excludes)
+
+    def excluded(p: Path) -> bool:
+        posix = p.as_posix()
+        return any(pat in posix for pat in excludes)
+
+    seen: set[Path] = set()
+    for raw in paths:
+        root = Path(raw)
+        candidates: Iterable[Path]
+        if root.is_dir():
+            candidates = sorted(root.rglob("*.py"))
+        elif root.suffix == ".py":
+            candidates = [root]
+        else:
+            continue
+        for p in candidates:
+            if p in seen or excluded(p):
+                continue
+            seen.add(p)
+            yield p
+
+
+def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = ()) -> tuple[list[Finding], dict]:
+    """Analyze every .py under ``paths`` -> (findings, stats)."""
+    findings: list[Finding] = []
+    n_files = 0
+    for p in iter_py_files(paths, excludes):
+        n_files += 1
+        findings.extend(analyze_file(p))
+    unsuppressed = sum(1 for f in findings if not f.suppressed)
+    stats = {
+        "files": n_files,
+        "findings": len(findings),
+        "unsuppressed": unsuppressed,
+        "suppressed": len(findings) - unsuppressed,
+    }
+    return findings, stats

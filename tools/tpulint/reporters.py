@@ -1,0 +1,68 @@
+"""Finding reporters: human text and machine JSON.
+
+The JSON shape is stable API for CI consumers:
+
+    {
+      "version": 1,
+      "findings": [{"path", "line", "col", "rule", "message",
+                    "suppressed", "justification"}, ...],
+      "stats": {"files", "findings", "unsuppressed", "suppressed"},
+      "rules": {"TPU001": "<summary>", ...}
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from tools.tpulint.core import Finding
+from tools.tpulint.rules import RULES
+
+JSON_SCHEMA_VERSION = 1
+
+
+def render_text(findings: Iterable[Finding], stats: dict, show_suppressed: bool = False) -> str:
+    lines: list[str] = []
+    for f in findings:
+        if f.suppressed and not show_suppressed:
+            continue
+        suffix = f"  [suppressed: {f.justification}]" if f.suppressed else ""
+        lines.append(f"{f.location()}: {f.rule} {f.message}{suffix}")
+    lines.append(
+        f"tpulint: {stats['files']} files, {stats['unsuppressed']} finding(s), "
+        f"{stats['suppressed']} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: Iterable[Finding], stats: dict) -> str:
+    payload = {
+        "version": JSON_SCHEMA_VERSION,
+        "findings": [
+            {
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "rule": f.rule,
+                "message": f.message,
+                "suppressed": f.suppressed,
+                "justification": f.justification,
+            }
+            for f in findings
+        ],
+        "stats": dict(stats),
+        "rules": {rule_id: rule.summary for rule_id, rule in RULES.items()},
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)
+
+
+def render_rule_list() -> str:
+    lines = []
+    for rule_id, rule in RULES.items():
+        lines.append(f"{rule_id}: {rule.summary}")
+        for chunk in rule.details.split(". "):
+            chunk = chunk.strip()
+            if chunk:
+                lines.append(f"    {chunk.rstrip('.')}.")
+    return "\n".join(lines)

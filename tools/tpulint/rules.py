@@ -1,0 +1,824 @@
+"""The tpulint rule set.
+
+Every rule is a pure function of one file's AST — no imports of the code
+under analysis, no runtime, stdlib only.  Rules yield ``(line, col,
+message)`` tuples; the driver (core.py) turns them into findings and
+applies suppression comments.
+
+Rule ids are stable API: they appear in suppression comments and in CI
+output, so renumbering is a breaking change.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterator
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'jax.random.split' for nested Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_shallow(fn: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function/class defs —
+    nested defs get analyzed as their own scopes."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def stored_names(target: ast.AST) -> set[str]:
+    """Plain names bound by an assignment target (tuples unpacked)."""
+    return {
+        n.id for n in ast.walk(target)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+    }
+
+
+# --------------------------------------------------------------------------
+# jit detection
+
+_JIT_DOTTED = {
+    "jit", "jax.jit", "pjit", "jax.pjit", "pjit.pjit", "jax.experimental.pjit.pjit",
+}
+_PARTIAL_DOTTED = {"partial", "functools.partial"}
+
+
+@dataclass
+class JitSpec:
+    """Static/donated argument declarations attached to one jit wrapping."""
+
+    static_names: set[str] = field(default_factory=set)
+    static_nums: set[int] = field(default_factory=set)
+    donate_nums: set[int] = field(default_factory=set)
+    donate_names: set[str] = field(default_factory=set)
+
+
+def _const_strs(node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+    return out
+
+
+def _const_ints(node: ast.AST) -> set[int]:
+    out: set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+    return out
+
+
+def _spec_from_keywords(keywords: list[ast.keyword]) -> JitSpec:
+    spec = JitSpec()
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            spec.static_names |= _const_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            spec.static_nums |= _const_ints(kw.value)
+        elif kw.arg == "donate_argnums":
+            spec.donate_nums |= _const_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            spec.donate_names |= _const_strs(kw.value)
+    return spec
+
+
+def jit_spec_of(expr: ast.AST) -> JitSpec | None:
+    """JitSpec when ``expr`` denotes a jit transform, else None.
+
+    Recognized shapes: ``jax.jit`` / ``pjit`` (bare), ``jax.jit(...)``
+    (configured call), ``partial(jax.jit, ...)`` / ``functools.partial``.
+    """
+    d = dotted(expr)
+    if d in _JIT_DOTTED:
+        return JitSpec()
+    if isinstance(expr, ast.Call):
+        fd = dotted(expr.func)
+        if fd in _PARTIAL_DOTTED and expr.args and dotted(expr.args[0]) in _JIT_DOTTED:
+            return _spec_from_keywords(expr.keywords)
+        if fd in _JIT_DOTTED:
+            return _spec_from_keywords(expr.keywords)
+    return None
+
+
+AnyFunc = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+def jitted_functions(tree: ast.Module) -> dict[AnyFunc, JitSpec]:
+    """Every def (at any nesting level) carrying a jit decorator."""
+    out: dict[AnyFunc, JitSpec] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                spec = jit_spec_of(deco)
+                if spec is not None:
+                    out[node] = spec
+                    break
+    return out
+
+
+def jitted_callables(tree: ast.Module) -> dict[str, JitSpec]:
+    """Names that resolve to jitted callables in this module: decorated
+    defs plus ``g = jax.jit(f, ...)`` aliases."""
+    out: dict[str, JitSpec] = {}
+    for fn, spec in jitted_functions(tree).items():
+        out[fn.name] = spec
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fd = dotted(node.value.func)
+            if fd in _JIT_DOTTED and node.value.args:
+                spec = _spec_from_keywords(node.value.keywords)
+                for name in stored_names(ast.Tuple(elts=node.targets, ctx=ast.Store())):
+                    out[name] = spec
+    return out
+
+
+def fn_params(fn: AnyFunc) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def traced_params(fn: AnyFunc, spec: JitSpec) -> set[str]:
+    """Parameter names traced under jit (everything not declared static)."""
+    positional = [p.arg for p in (*fn.args.posonlyargs, *fn.args.args)]
+    static = set(spec.static_names)
+    for i in sorted(spec.static_nums):
+        if 0 <= i < len(positional):
+            static.add(positional[i])
+    return {p for p in fn_params(fn) if p not in static and p not in ("self", "cls")}
+
+
+# --------------------------------------------------------------------------
+# rule registry
+
+@dataclass
+class Rule:
+    id: str
+    summary: str
+    details: str
+    checker: "object" = None
+
+    def check(self, ctx: "FileContext") -> Iterator[tuple[int, int, str]]:
+        yield from self.checker(ctx)
+
+
+@dataclass
+class FileContext:
+    path: str
+    source: str
+    tree: ast.Module
+
+    @property
+    def is_test_file(self) -> bool:
+        base = self.path.rsplit("/", 1)[-1]
+        return base.startswith(("test_", "conftest"))
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register(rule_id: str, summary: str, details: str):
+    def deco(fn):
+        RULES[rule_id] = Rule(rule_id, summary, details, fn)
+        return fn
+    return deco
+
+
+# --------------------------------------------------------------------------
+# TPU001 — Python control flow on traced values inside jit
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding", "itemsize", "weak_type"}
+_STRUCTURAL_CALLS = {"isinstance", "len", "getattr", "hasattr", "callable", "type"}
+_CONCRETIZING_CALLS = {"bool", "float", "int", "complex"}
+_CONCRETIZING_METHODS = {"item", "tolist", "__bool__", "__float__", "__int__"}
+
+
+def _traced_value_uses(expr: ast.AST, traced: set[str]) -> Iterator[ast.Name]:
+    """Name nodes in ``expr`` whose *value* (not shape/dtype/structure) is
+    consumed — skipping subtrees that only inspect static properties."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            continue  # x.shape / x.dtype comparisons are trace-static
+        if isinstance(node, ast.Call):
+            fd = dotted(node.func)
+            if fd in _STRUCTURAL_CALLS:
+                continue
+        if isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            # `x is None` / `x is not None` dispatches on pytree structure
+            if isinstance(node.ops[0], (ast.Is, ast.IsNot)) and (
+                isinstance(node.comparators[0], ast.Constant)
+                and node.comparators[0].value is None
+            ):
+                continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) and node.id in traced:
+            yield node
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register(
+    "TPU001",
+    "Python branch on a traced value inside a jitted function",
+    "`if`/`while`/`bool()`/`float()`/`.item()` on a value traced under "
+    "@jax.jit forces a concretization error or a silent host sync at trace "
+    "time. Use jnp.where / lax.cond / lax.while_loop, or declare the "
+    "argument static.",
+)
+def check_tpu001(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    for fn, spec in jitted_functions(ctx.tree).items():
+        traced = traced_params(fn, spec)
+        if not traced:
+            continue
+        for node in walk_shallow(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                for name in _traced_value_uses(node.test, traced):
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"Python {'while' if isinstance(node, ast.While) else 'if'} "
+                        f"on traced value '{name.id}' inside jitted '{fn.name}' — "
+                        "use jnp.where/lax.cond/lax.while_loop or mark it static",
+                    )
+            elif isinstance(node, ast.Call):
+                fd = dotted(node.func)
+                if fd in _CONCRETIZING_CALLS and node.args:
+                    arg = node.args[0]
+                    root = arg.value if isinstance(arg, ast.Subscript) else arg
+                    if isinstance(root, ast.Name) and root.id in traced:
+                        yield (
+                            node.lineno, node.col_offset,
+                            f"{fd}() concretizes traced value '{root.id}' inside "
+                            f"jitted '{fn.name}' — this blocks on device transfer "
+                            "or fails at trace time",
+                        )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CONCRETIZING_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in traced
+                ):
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"'.{node.func.attr}()' on traced value "
+                        f"'{node.func.value.id}' inside jitted '{fn.name}' — "
+                        "device→host sync on the traced path",
+                    )
+
+
+# --------------------------------------------------------------------------
+# TPU002 — numpy ops inside jit
+
+_NUMPY_ROOTS = ("np.", "numpy.", "onp.")
+
+
+@register(
+    "TPU002",
+    "numpy call inside a jitted function",
+    "np.* executes on host at trace time: on traced values it forces a "
+    "device→host transfer (or a TracerArrayConversionError); on constants "
+    "it silently bakes them in. Use jnp.* inside jit.",
+)
+def check_tpu002(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    for fn, _spec in jitted_functions(ctx.tree).items():
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Call):
+                fd = dotted(node.func)
+                if fd and fd.startswith(_NUMPY_ROOTS):
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"{fd}() inside jitted '{fn.name}' runs on host — "
+                        "use the jnp equivalent (or hoist it out of the jit)",
+                    )
+
+
+# --------------------------------------------------------------------------
+# TPU003 — recompilation hazards (shapes from Python scalars)
+
+_CREATION_ANY_ARG = {"zeros", "ones", "empty", "arange", "eye", "linspace", "tri", "iota"}
+_ARRAY_ROOTS = {"jnp", "jax", "lax", "np", "numpy"}
+
+
+def _shape_position_args(call: ast.Call) -> list[ast.AST]:
+    """Arguments of ``call`` that are interpreted as shapes/sizes."""
+    fd = dotted(call.func)
+    attr: str | None = None
+    rooted = False
+    if fd:
+        parts = fd.split(".")
+        attr = parts[-1]
+        rooted = parts[0] in _ARRAY_ROOTS
+    elif isinstance(call.func, ast.Attribute):
+        attr = call.func.attr  # method call on a computed receiver
+    if attr is None:
+        return []
+    out: list[ast.AST] = []
+    if attr in _CREATION_ANY_ARG and rooted:
+        out.extend(call.args)  # jnp.zeros(n), jnp.arange(n), lax.iota(..., n)
+    elif attr == "full" and rooted and call.args:
+        out.append(call.args[0])  # jnp.full(shape, fill) — fill may be traced
+    elif attr in ("broadcast_to", "tile") and rooted:
+        out.extend(call.args[1:])
+    elif attr == "reshape":
+        if rooted:
+            out.extend(call.args[1:])  # jnp.reshape(x, shape)
+        else:
+            out.extend(call.args)  # x.reshape(n, m)
+    else:
+        return []
+    for kw in call.keywords:
+        if kw.arg == "shape":
+            out.append(kw.value)
+    return out
+
+
+@register(
+    "TPU003",
+    "shape-varying Python scalar crosses a jit boundary without static declaration",
+    "A traced parameter used as a shape (jnp.zeros(n), x.reshape(n, ...)) "
+    "fails or silently recompiles; len(...) fed straight into a jitted call "
+    "recompiles per distinct length. Declare static_argnums/static_argnames "
+    "and pad/bucket the value (utils.next_bucket).",
+)
+def check_tpu003(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    # (a) traced param in a shape position inside the jitted body
+    for fn, spec in jitted_functions(ctx.tree).items():
+        traced = traced_params(fn, spec)
+        if traced:
+            for node in walk_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for arg in _shape_position_args(node):
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Attribute) and sub.attr in _SHAPE_ATTRS:
+                            break
+                    else:
+                        for name in ast.walk(arg):
+                            if (
+                                isinstance(name, ast.Name)
+                                and isinstance(name.ctx, ast.Load)
+                                and name.id in traced
+                            ):
+                                yield (
+                                    node.lineno, node.col_offset,
+                                    f"traced parameter '{name.id}' used as a shape "
+                                    f"inside jitted '{fn.name}' — declare it in "
+                                    "static_argnums/static_argnames (and bucket "
+                                    "callers so it doesn't recompile per value)",
+                                )
+    # (b) len(...) passed straight into a known-jitted callable
+    jitted = jitted_callables(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if node.func.id in jitted:
+                for arg in (*node.args, *(kw.value for kw in node.keywords)):
+                    if (
+                        isinstance(arg, ast.Call)
+                        and isinstance(arg.func, ast.Name)
+                        and arg.func.id == "len"
+                    ):
+                        yield (
+                            node.lineno, node.col_offset,
+                            f"len(...) passed straight into jitted "
+                            f"'{node.func.id}' — a static arg recompiles per "
+                            "distinct length; pad or bucket it first "
+                            "(utils.next_bucket)",
+                        )
+
+
+# --------------------------------------------------------------------------
+# TPU004 — PRNG key reuse
+
+_RNG_CONSUMERS = {
+    "normal", "uniform", "categorical", "bernoulli", "gumbel", "randint",
+    "truncated_normal", "permutation", "choice", "exponential", "beta",
+    "gamma", "poisson", "bits", "ball", "cauchy", "dirichlet", "laplace",
+    "loggamma", "maxwell", "rademacher", "orthogonal", "split",
+}
+
+
+def _rng_key_use(node: ast.Call) -> str | None:
+    """Name of the key consumed by a jax.random sampler call, if any."""
+    fd = dotted(node.func)
+    if not fd:
+        return None
+    parts = fd.split(".")
+    if parts[-1] not in _RNG_CONSUMERS:
+        return None
+    if not (fd.startswith("jax.random.") or fd.startswith("random.") or fd.startswith("jrandom.")):
+        return None
+    key_arg: ast.AST | None = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "key":
+            key_arg = kw.value
+    if isinstance(key_arg, ast.Name):
+        return key_arg.id
+    return None
+
+
+@register(
+    "TPU004",
+    "jax.random key reused without split",
+    "Consuming the same PRNG key twice yields identical 'random' numbers "
+    "(and inside a Python loop, every iteration repeats). split the key, or "
+    "fold_in a counter.",
+)
+def check_tpu004(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        uses: list[tuple[int, int, str, ast.Call]] = []  # line, col, name, node
+        binds: dict[str, list[int]] = {}
+        loops: list[tuple[int, int]] = []  # (start, end) line ranges
+
+        for node in walk_shallow(fn):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                loops.append((node.lineno, node.end_lineno or node.lineno))
+            if isinstance(node, ast.Call):
+                key = _rng_key_use(node)
+                if key is not None:
+                    uses.append((node.lineno, node.col_offset, key, node))
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                binds.setdefault(node.id, []).append(node.lineno)
+
+        # loop-reuse: a key consumed inside a loop it is never re-bound in
+        for line, col, key, _node in uses:
+            for lo, hi in loops:
+                if lo < line <= hi and not any(lo <= b <= hi for b in binds.get(key, ())):
+                    yield (
+                        line, col,
+                        f"PRNG key '{key}' consumed inside a loop without being "
+                        "re-bound — every iteration gets identical randomness; "
+                        "split per iteration or fold_in the index",
+                    )
+                    break
+
+        # linear reuse: second consumption without an intervening re-bind
+        events: list[tuple[int, int, str, int, int]] = []
+        for line, col, key, _node in uses:
+            events.append((line, 0, key, line, col))  # uses before binds on a line
+        for key, lines in binds.items():
+            for line in lines:
+                events.append((line, 1, key, line, 0))
+        consumed: dict[str, int] = {}
+        for line, kind, key, fline, fcol in sorted(events):
+            if kind == 1:
+                consumed.pop(key, None)
+            else:
+                if key in consumed:
+                    yield (
+                        fline, fcol,
+                        f"PRNG key '{key}' already consumed at line "
+                        f"{consumed[key]} — re-using it repeats the same "
+                        "randomness; use jax.random.split",
+                    )
+                consumed[key] = line
+
+
+# --------------------------------------------------------------------------
+# TPU005 — host sync on the hot decode path
+
+_HOT_NAME_RE = re.compile(r"step|decode|burst|prefill", re.IGNORECASE)
+_SYNC_DOTTED = {"jax.block_until_ready", "jax.device_get", "jax.effects_barrier"}
+
+
+@register(
+    "TPU005",
+    "blocking device sync inside a step/decode/prefill function",
+    ".block_until_ready() / jax.device_get on the hot path serializes the "
+    "TPU against the Python driver and collapses tokens/s. Keep the decode "
+    "loop async; sync only at commit points and flag those explicitly.",
+)
+def check_tpu005(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    if ctx.is_test_file:
+        return  # tests/benches sync deliberately to time or assert
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _HOT_NAME_RE.search(fn.name):
+            continue
+        for node in walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fd = dotted(node.func)
+            if fd in _SYNC_DOTTED:
+                yield (
+                    node.lineno, node.col_offset,
+                    f"{fd}() inside hot-path '{fn.name}' blocks the driver "
+                    "thread on the device — move it off the decode loop",
+                )
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == "block_until_ready":
+                yield (
+                    node.lineno, node.col_offset,
+                    f".block_until_ready() inside hot-path '{fn.name}' blocks "
+                    "the driver thread on the device — move it off the decode "
+                    "loop",
+                )
+
+
+# --------------------------------------------------------------------------
+# TPU006 — donated buffer referenced after the jitted call
+
+@register(
+    "TPU006",
+    "donated jit argument referenced after the call",
+    "donate_argnums hands the buffer to XLA; reading it after the call "
+    "returns garbage or raises. Rebind the result over the donated name "
+    "(params, opt = step(params, opt, ...)).",
+)
+def check_tpu006(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    donating = {
+        name: spec.donate_nums
+        for name, spec in jitted_callables(ctx.tree).items()
+        if spec.donate_nums
+    }
+    if not donating:
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # statement-ordered scan of this scope
+        calls: list[tuple[int, str, set[str]]] = []  # line, callee, donated arg names
+        binds: dict[str, list[int]] = {}
+        loads: dict[str, list[tuple[int, int]]] = {}
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    binds.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append((node.lineno, node.col_offset))
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in donating
+            ):
+                donated: set[str] = set()
+                for i, arg in enumerate(node.args):
+                    if i in donating[node.func.id] and isinstance(arg, ast.Name):
+                        donated.add(arg.id)
+                if donated:
+                    calls.append((node.lineno, node.func.id, donated))
+        for call_line, callee, donated in calls:
+            for name in donated:
+                rebind_lines = [b for b in binds.get(name, ()) if b >= call_line]
+                next_rebind = min(rebind_lines) if rebind_lines else None
+                for load_line, load_col in loads.get(name, ()):
+                    if load_line <= call_line:
+                        continue
+                    if next_rebind is not None and load_line >= next_rebind:
+                        continue
+                    yield (
+                        load_line, load_col,
+                        f"'{name}' was donated to jitted '{callee}' at line "
+                        f"{call_line} and read afterwards — the buffer may "
+                        "already be invalidated; rebind the result over it",
+                    )
+                    break
+
+
+# --------------------------------------------------------------------------
+# ASY001 — blocking calls inside async def
+
+_BLOCKING_CALLS = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "subprocess.run": "asyncio.create_subprocess_exec or run_in_executor",
+    "subprocess.call": "asyncio.create_subprocess_exec or run_in_executor",
+    "subprocess.check_call": "asyncio.create_subprocess_exec or run_in_executor",
+    "subprocess.check_output": "asyncio.create_subprocess_exec or run_in_executor",
+    "subprocess.Popen": "asyncio.create_subprocess_exec",
+    "os.system": "asyncio.create_subprocess_shell",
+    "os.popen": "asyncio.create_subprocess_shell",
+    "requests.get": "aiohttp.ClientSession or run_in_executor",
+    "requests.post": "aiohttp.ClientSession or run_in_executor",
+    "requests.put": "aiohttp.ClientSession or run_in_executor",
+    "requests.patch": "aiohttp.ClientSession or run_in_executor",
+    "requests.delete": "aiohttp.ClientSession or run_in_executor",
+    "requests.head": "aiohttp.ClientSession or run_in_executor",
+    "requests.request": "aiohttp.ClientSession or run_in_executor",
+    "urllib.request.urlopen": "aiohttp.ClientSession or run_in_executor",
+    "socket.create_connection": "asyncio.open_connection",
+}
+
+
+@register(
+    "ASY001",
+    "blocking call inside an async function",
+    "time.sleep / sync HTTP / subprocess inside `async def` freezes the "
+    "whole event loop: every SSE stream, health probe, and engine submit "
+    "stalls behind it. Await the async equivalent or push it to an "
+    "executor.",
+)
+def check_asy001(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Call):
+                fd = dotted(node.func)
+                if fd in _BLOCKING_CALLS:
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"blocking {fd}() inside async '{fn.name}' stalls the "
+                        f"event loop — use {_BLOCKING_CALLS[fd]}",
+                    )
+
+
+# --------------------------------------------------------------------------
+# ASY002 — shared state mutated across an await without a lock
+
+_LOCKISH_RE = re.compile(r"lock|sem|mutex", re.IGNORECASE)
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    d = dotted(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+    return bool(d and _LOCKISH_RE.search(d))
+
+
+def _self_attr_reads(node: ast.AST) -> set[str]:
+    return {
+        n.attr for n in ast.walk(node)
+        if isinstance(n, ast.Attribute)
+        and isinstance(n.ctx, ast.Load)
+        and isinstance(n.value, ast.Name) and n.value.id == "self"
+    }
+
+
+def _self_attr_writes(node: ast.AST) -> set[str]:
+    return {
+        n.attr for n in ast.walk(node)
+        if isinstance(n, ast.Attribute)
+        and isinstance(n.ctx, (ast.Store, ast.Del))
+        and isinstance(n.value, ast.Name) and n.value.id == "self"
+    }
+
+
+def _method_writes(cls: ast.ClassDef) -> dict[str, set[str]]:
+    """method name -> self attributes it assigns."""
+    out: dict[str, set[str]] = {}
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[item.name] = _self_attr_writes(item)
+    return out
+
+
+def _property_reads(cls: ast.ClassDef) -> dict[str, set[str]]:
+    """@property name -> self attributes its getter reads."""
+    out: dict[str, set[str]] = {}
+    for item in cls.body:
+        if isinstance(item, ast.FunctionDef):
+            for deco in item.decorator_list:
+                if dotted(deco) == "property":
+                    out[item.name] = _self_attr_reads(item)
+    return out
+
+
+def _iter_stmts(body: list[ast.stmt], protected: bool) -> Iterator[tuple[ast.stmt, bool]]:
+    """Flatten statements in source order, tracking lock protection."""
+    for stmt in body:
+        yield stmt, protected
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = protected or any(_is_lockish(item.context_expr) for item in stmt.items)
+            yield from _iter_stmts(stmt.body, inner)
+            continue
+        for field_name in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field_name, None)
+            if sub:
+                yield from _iter_stmts(sub, protected)
+        for handler in getattr(stmt, "handlers", ()):
+            yield from _iter_stmts(handler.body, protected)
+
+
+def _stmt_own_parts(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """The statement's own expressions, excluding nested statement bodies
+    (those are visited as their own statements)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        yield stmt.test
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        yield stmt.target
+        yield stmt.iter
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            yield item.context_expr
+    elif isinstance(stmt, ast.Try):
+        return
+    else:
+        yield stmt
+
+
+@register(
+    "ASY002",
+    "self attribute read and written across an await without a lock",
+    "Between reading self.x and writing it back, an await yields the loop: "
+    "another task interleaves and one update is lost (or two tasks both "
+    "pass a check-then-act guard). Hold an asyncio.Lock across the span, "
+    "or capture-and-clear before awaiting.",
+)
+def check_asy002(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        writes_by_method = _method_writes(cls)
+        prop_reads = _property_reads(cls)
+        for fn in cls.body:
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+
+            # (a) linear read -> await -> write on the same attribute
+            read_lines: dict[str, list[int]] = {}
+            await_lines: list[int] = []
+            for stmt, protected in _iter_stmts(fn.body, False):
+                parts = list(_stmt_own_parts(stmt))
+                reads: set[str] = set()
+                writes: set[str] = set()
+                has_await = False
+                for part in parts:
+                    reads |= _self_attr_reads(part)
+                    writes |= _self_attr_writes(part)
+                    has_await = has_await or any(
+                        isinstance(n, ast.Await) for n in ast.walk(part)
+                    )
+                if isinstance(stmt, ast.AugAssign):
+                    # `self.x += ...` reads x even though the AST only Stores it
+                    reads |= writes
+                if not protected:
+                    for attr in writes:
+                        hit = any(
+                            r < a < stmt.lineno
+                            for r in read_lines.get(attr, ())
+                            for a in await_lines
+                        )
+                        if hit or (has_await and attr in reads):
+                            yield (
+                                stmt.lineno, stmt.col_offset,
+                                f"'self.{attr}' is read, then an await yields "
+                                f"the event loop, then it is written (async "
+                                f"'{fn.name}') — concurrent tasks interleave "
+                                "here; hold an asyncio.Lock or "
+                                "capture-and-clear before awaiting",
+                            )
+                    for attr in reads - writes:
+                        read_lines.setdefault(attr, []).append(stmt.lineno)
+                for attr in writes:
+                    read_lines.pop(attr, None)  # a write starts a fresh epoch
+                if has_await:
+                    await_lines.append(stmt.lineno)
+
+            # (b) check-then-act: guard reads self state, body awaits a
+            #     method of this class that assigns the same state
+            for stmt, protected in _iter_stmts(fn.body, False):
+                if protected or not isinstance(stmt, ast.If):
+                    continue
+                guard_reads = _self_attr_reads(stmt.test)
+                resolved = set(guard_reads)
+                for attr in guard_reads:
+                    resolved |= prop_reads.get(attr, set())
+                if not resolved:
+                    continue
+                for node in ast.walk(stmt):
+                    if (
+                        isinstance(node, ast.Await)
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and isinstance(node.value.func.value, ast.Name)
+                        and node.value.func.value.id == "self"
+                    ):
+                        method = node.value.func.attr
+                        overlap = resolved & writes_by_method.get(method, set())
+                        if overlap:
+                            attrs = ", ".join(sorted(f"self.{a}" for a in overlap))
+                            yield (
+                                node.lineno, node.col_offset,
+                                f"check-then-act across await in async "
+                                f"'{fn.name}': the guard reads state that "
+                                f"awaited 'self.{method}()' assigns ({attrs}) "
+                                "— two tasks can both pass the check; hold an "
+                                "asyncio.Lock around the whole span",
+                            )
