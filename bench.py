@@ -256,7 +256,7 @@ def _timed_generate(engine, prompts, sp):
     return [done[rid] for rid in order], phases
 
 
-def bench_concurrency(cfg, *, streams: int, prompt_len: int, gen_tokens: int,
+def bench_concurrency(cfg, *, streams: int, prompt_len, gen_tokens: int,
                       engine, trials: int = 1,
                       seed0: int = 1) -> tuple[float, float, dict]:
     """Eval config #5 shape: many concurrent streams through continuous
@@ -264,13 +264,24 @@ def bench_concurrency(cfg, *, streams: int, prompt_len: int, gen_tokens: int,
     wave with FRESH prompts (prefix caching would serve repeated prompts
     from cache) and keeps the MEDIAN-throughput trial — one tunnel hiccup or
     stray compile in a ~3 s run otherwise swings the aggregate 8x
-    (VERDICT r04 next-round #1)."""
+    (VERDICT r04 next-round #1).
+
+    ``prompt_len``: an int for a uniform wave, or an ``(lo, hi)`` tuple for
+    a mixed-length wave (each stream's length drawn per trial — the
+    promptheavy scenario, where padded-vs-packed prefill differ)."""
     from githubrepostorag_tpu.serving.sampling_params import SamplingParams
 
     sp = SamplingParams(max_tokens=gen_tokens, temperature=0.7, stop_token_ids=())
     outcomes = []  # (agg, p50, phases)
     for t in range(trials):
-        prompts = _prompts(streams, prompt_len, cfg.vocab_size, seed=seed0 + t)
+        if isinstance(prompt_len, tuple):
+            rng = np.random.default_rng(seed0 + t)
+            lens = rng.integers(prompt_len[0], prompt_len[1] + 1, streams)
+            prompts = [rng.integers(0, cfg.vocab_size, int(n)).tolist()
+                       for n in lens]
+        else:
+            prompts = _prompts(streams, prompt_len, cfg.vocab_size,
+                               seed=seed0 + t)
         results, phases = _timed_generate(engine, prompts, sp)
         toks = sum(len(r.output_tokens) for r in results)
         ttfts = sorted(r.ttft_s for r in results if r.ttft_s is not None)
@@ -289,6 +300,48 @@ def bench_concurrency(cfg, *, streams: int, prompt_len: int, gen_tokens: int,
     agg, p50, phases = outcomes[(len(outcomes) - 1) // 2]
     phases = dict(phases, trial_aggs=[round(o[0], 1) for o in outcomes])
     return agg, p50, phases
+
+
+def bench_promptheavy_pair(cfg, params, tag: str, *, streams: int,
+                           len_range: tuple[int, int], gen_tokens: int,
+                           geom: dict, packed_budget: int,
+                           trials: int = 3) -> dict:
+    """``conc64_promptheavy``: padded vs token-budget-packed prefill on the
+    SAME prompt-heavy mixed-length workload (RAG traffic — each stream
+    carries a 1k-2k-token retrieved context, lengths heterogeneous across
+    the wave, so the padded [row_bucket, width] dispatch pads every row to
+    the widest pending chunk while the packed path spends FLOPs on real
+    tokens only).  Two engines, identical geometry except the prefill
+    dispatch mode; emits agg tok/s + p50 TTFT for both plus the
+    packed/padded ratios the acceptance gate reads."""
+    from githubrepostorag_tpu.serving.engine import Engine
+
+    out = {}
+    for mode in ("padded", "packed"):
+        kw = dict(geom)
+        if mode == "packed":
+            kw.pop("prefill_widths", None)  # ignored under a token budget
+            kw["prefill_token_budget"] = packed_budget
+        eng = Engine(params, cfg, **kw)
+        log(f"bench[{tag}]: warmup ({mode})")
+        eng.warmup()
+        agg, p50, ph = bench_concurrency(
+            cfg, streams=streams, prompt_len=len_range,
+            gen_tokens=gen_tokens, engine=eng, trials=trials, seed0=11)
+        out[mode] = (agg, p50)
+        emit(f"{tag}_agg_tok_s_{mode}", agg, "tok/s",
+             agg / BASELINE_TOK_S, **ph)
+        emit(f"{tag}_p50_ttft_{mode}", p50, "s",
+             BASELINE_TTFT_S / max(p50, 1e-9))
+        del eng
+        gc.collect()
+    agg_x = out["packed"][0] / max(out["padded"][0], 1e-9)
+    ttft_x = out["packed"][1] / max(out["padded"][1], 1e-9)
+    emit(f"{tag}_packed_agg_speedup", agg_x, "x", None)
+    emit(f"{tag}_packed_ttft_ratio", ttft_x, "x", None)
+    log(f"bench[{tag}]: packed/padded agg {agg_x:.2f}x, "
+        f"p50 TTFT ratio {ttft_x:.2f}x")
+    return out
 
 
 def bench_extractor_batch(cfg, *, docs: int, prompt_len: int,
@@ -599,10 +652,22 @@ def _main() -> None:
 
     if not on_tpu:  # CPU fallback so the script still demonstrates end to end
         cfg = Qwen2Config.tiny()
-        tps, _, _ = bench_decode(cfg, "tiny-cpu", batch=4, prompt_len=32,
-                                 gen_tokens=16, num_pages=128, page_size=16,
-                                 max_seq=256, runs=1, decode_burst=16)
+        tps, _, params_t = bench_decode(cfg, "tiny-cpu", batch=4, prompt_len=32,
+                                        gen_tokens=16, num_pages=128,
+                                        page_size=16, max_seq=256, runs=1,
+                                        decode_burst=16)
         emit("decode_tok_s_tiny_cpu", tps, "tok/s", tps / BASELINE_TOK_S)
+        # tiny-scale conc64_promptheavy A/B: the same padded-vs-packed pair
+        # as the TPU items, shrunk so XLA-on-CPU stays in seconds.  The
+        # packed win is geometry-RELATIVE (real tokens vs rows x widest
+        # pending chunk), so a heterogeneous tiny wave still demonstrates
+        # the dispatch-mode delta end to end.
+        geom_t = dict(max_num_seqs=4, num_pages=64, page_size=8,
+                      max_seq_len=128, prefill_chunk=32, use_pallas=False,
+                      decode_burst=8, prefill_widths=2)
+        bench_promptheavy_pair(
+            cfg, params_t, "conc64_promptheavy_tiny_cpu", streams=16,
+            len_range=(16, 96), gen_tokens=8, geom=geom_t, packed_budget=64)
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------
@@ -679,6 +744,25 @@ def _main() -> None:
             emit("conc64_7b_decode_wall_s", ph7["decode_wall_s"], "s", None)
             emit("conc64_7b_max_step_s", ph7["max_step_s"], "s", None)
             del eng7c
+            gc.collect()
+        # ---- conc64_promptheavy: 1k-2k-token RAG prompts, padded vs
+        # token-budget PACKED prefill on the same workload.  max_num_seqs=16:
+        # all 64 streams still queue through continuous batching (p50 TTFT
+        # includes queue wait), but 16 resident ~2k-token rows bound the KV
+        # HBM (~2 GB at this geometry) next to the ~8 GB int8 tree.  The
+        # packed budget (2048 = 8 full chunks) replaces the per-wave
+        # [row_bucket, width] dispatch grid with ONE [budget] buffer shape
+        # per row bucket — on heterogeneous long prompts the padded path
+        # pays rows x widest-pending-chunk FLOPs every wave.
+        if budget_allows("conc64-promptheavy-7b", 420):
+            geom7p = dict(max_num_seqs=16, num_pages=320, page_size=128,
+                          max_seq_len=2304, prefill_chunk=256,
+                          use_pallas=True, decode_burst=32,
+                          prefill_priority=True, prefill_widths=2)
+            bench_promptheavy_pair(
+                cfg7, params7, "conc64_promptheavy_qwen2-7b_int8",
+                streams=64, len_range=(1024, 2048), gen_tokens=64,
+                geom=geom7p, packed_budget=2048)
         del params7
         gc.collect()
 
@@ -988,6 +1072,20 @@ def _main() -> None:
                                               gen_tokens=32, engine=eng)
             emit("extractor_batch1k_docs_s_qwen2-0.5b", docs_s, "docs/s", None)
         del eng
+        gc.collect()
+
+    # ---- conc64_promptheavy on 0.5B: the same padded-vs-packed prefill
+    # A/B as the 7B item, at the cheap-model geometry (32 resident rows —
+    # 0.5B KV is ~12 KB/token, so 2k-token rows are affordable wider) -----
+    if budget_allows("conc64-promptheavy-0.5b", 300):
+        geom05p = dict(max_num_seqs=32, num_pages=640, page_size=128,
+                       max_seq_len=2304, prefill_chunk=256, use_pallas=True,
+                       decode_burst=32, prefill_priority=True,
+                       prefill_widths=2)
+        bench_promptheavy_pair(
+            cfg05, params05_or_init(), "conc64_promptheavy_qwen2-0.5b",
+            streams=64, len_range=(1024, 2048), gen_tokens=64,
+            geom=geom05p, packed_budget=2048)
         gc.collect()
 
     # ---- int8 KV cache: same 64-stream config over quantized pages -------

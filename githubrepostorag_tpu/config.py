@@ -151,6 +151,14 @@ class Settings:
     prefill_widths: int = field(
         default_factory=lambda: _env_int("PREFILL_WIDTHS", 1)
     )
+    # >0: token-budget PACKED prefill — every prefilling row's next chunk
+    # packs into one [budget] buffer with segment-ID attention instead of
+    # the padded [row_bucket, width] dispatch; prefill FLOPs scale with
+    # real tokens on heterogeneous prompt-heavy waves and PREFILL_WIDTHS
+    # is ignored (serving/engine.py prefill_token_budget).  0 = padded.
+    prefill_token_budget: int = field(
+        default_factory=lambda: _env_int("PREFILL_TOKEN_BUDGET", 0)
+    )
     # "native" = in-tree C++ byte-level BPE (serving/bpe_native.py) when the
     # checkpoint has a tokenizer.json; "hf" = transformers AutoTokenizer
     tokenizer_backend: str = field(

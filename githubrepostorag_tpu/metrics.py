@@ -53,6 +53,16 @@ PREFIX_CACHE_HITS = Counter(
     "Prompt tokens served from the KV prefix cache instead of prefill",
     registry=REGISTRY,
 )
+PACKED_PREFILL_TOKENS = Counter(
+    "rag_packed_prefill_tokens_total",
+    "Real prompt tokens dispatched by the token-budget packed prefill",
+    registry=REGISTRY,
+)
+PACKED_PREFILL_PADDING = Counter(
+    "rag_packed_prefill_padding_total",
+    "Unused packed-prefill budget slots (buffer padding dispatched)",
+    registry=REGISTRY,
+)
 SPEC_PROPOSED = Counter(
     "rag_spec_draft_tokens_total", "Speculative draft tokens proposed", registry=REGISTRY
 )

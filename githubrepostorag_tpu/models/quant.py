@@ -427,10 +427,23 @@ def quantize_qwen2_params(
     # router and the [d, 1] shared gate stay full precision — they are
     # tiny and routing decisions are the precision-sensitive part of a
     # sparse model.  Norms and biases are never in this list.
+    matched = 0
     for name in ("wq", "wk", "wv", "wqkv", "wo", "wg", "wu", "wgu", "wd",
                  "e_wg", "e_wu", "e_wd", "s_wg", "s_wu", "s_wd"):
         if name in layers:
             layers[name] = qw(layers[name])
+            matched += 1
+    if matched == 0:
+        # A renamed/foreign tree must fail loudly: every known layout has
+        # at least one projection leaf, and returning the tree untouched
+        # would silently serve FULL-PRECISION weights under
+        # quantizeWeights:"int8" (no error, just 2x the HBM and none of
+        # the speedup — the failure only shows up in a memory profile)
+        raise ValueError(
+            "quantize_qwen2_params: no known projection leaf found in "
+            f"params['layers'] (keys: {sorted(layers)}); the tree would "
+            "pass through at full precision"
+        )
     out["layers"] = layers
     if "lm_head" in params:
         out["lm_head"] = qw(params["lm_head"])

@@ -532,3 +532,110 @@ def forward_paged_impl(
     if quant:
         return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "tq", "use_pallas", "int4_kernel"),
+    donate_argnums=(4, 5),
+)
+def forward_paged_packed(
+    params: dict,
+    cfg: Qwen2Config,
+    input_ids: jnp.ndarray,  # [1, T] int32 packed token buffer (T = budget)
+    positions: jnp.ndarray,  # [1, T] int32 absolute positions per token
+    k_pages: jnp.ndarray,  # [L, n_kv, P, page_size, hd] (donated)
+    v_pages: jnp.ndarray,  # (donated)
+    slot_mapping: jnp.ndarray,  # [T] int32 flat pool slots, -1 for padding
+    block_tables: jnp.ndarray,  # [R, max_pages] int32 per SEGMENT
+    cached_lens: jnp.ndarray,  # [R] tokens in cache before this chunk
+    new_lens: jnp.ndarray,  # [R] valid new tokens this chunk
+    seg_ids: jnp.ndarray,  # [T] int32 owning segment; >= R marks padding
+    logits_at: jnp.ndarray,  # [R] packed-buffer index of each segment's
+    # last token (the generalized per-segment logits_at)
+    tq: int,  # static per-segment chunk cap — min(prefill_chunk, budget)
+    use_pallas: bool = False,
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
+    int4_kernel: bool = True,
+):
+    """Token-budget packed prefill step over the paged KV cache.
+
+    The padded ``forward_paged`` prefill runs [row_bucket, width] with every
+    row padded to the widest pending chunk; this variant runs ONE flat
+    [1, budget] buffer holding every prefilling row's next chunk back to
+    back, so embedding/projection/MLP FLOPs — the bulk of prefill compute —
+    scale with real tokens.  Attention runs the segment-masked path
+    (ops/packed_prefill.py): per-token ``seg_ids`` map tokens to block
+    tables / cached lengths, causal structure is per segment.
+
+    New K/V are scattered into the page pools at ``slot_mapping`` exactly
+    like forward_paged (padding slots -1 drop).  Returns
+    (logits [R, 1, V], k_pages, v_pages[, k_scales, v_scales]) — logits
+    are per SEGMENT at each segment's last packed position, so the engine's
+    [row-bucket] sampling program is unchanged."""
+    from githubrepostorag_tpu.ops.packed_prefill import packed_prefill_attention
+
+    quant = k_scales is not None
+    nkv, hd = cfg.num_kv_heads, cfg.head_dim
+    num_pages, page_size = k_pages.shape[2], k_pages.shape[3]
+    total_slots = num_pages * page_size
+
+    h = embedding_lookup(params["embed"], input_ids, dtype=_embed_dtype(params))
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    flat_slots = slot_mapping.reshape(-1)  # [T]
+    flat_slots = jnp.where(flat_slots < 0, total_slots, flat_slots)
+    pos_flat = positions.reshape(-1)
+
+    scan_layers, q4_stacks = _split_q4(params["layers"])
+
+    def body(carry, layer_xs):
+        h, li = carry
+        if quant:
+            p, kp, vp, ks, vs = layer_xs
+        else:
+            p, kp, vp = layer_xs
+            ks = vs = None
+        # same w4a8=False pin as forward_paged: prompt processing keeps the
+        # exact bf16-dequant contract regardless of the packed buffer size
+        p = _with_layered_q4(p, q4_stacks, li, kernel=int4_kernel, w4a8=False)
+
+        def attend(q, k, v):
+            from githubrepostorag_tpu.serving.kv_cache import commit_paged
+
+            k_t = k.reshape(-1, nkv, hd).swapaxes(0, 1)  # [n_kv, T, hd]
+            v_t = v.reshape(-1, nkv, hd).swapaxes(0, 1)
+            new_kp, new_ks = commit_paged(
+                kp, k_t, flat_slots, ks if quant else None, page_size
+            )
+            new_vp, new_vs = commit_paged(
+                vp, v_t, flat_slots, vs if quant else None, page_size
+            )
+            attn = packed_prefill_attention(
+                q[0], new_kp, new_vp, block_tables, cached_lens, new_lens,
+                seg_ids, pos_flat, tq=tq, use_pallas=use_pallas,
+                k_scales=new_ks if quant else None,
+                v_scales=new_vs if quant else None,
+            )[None]  # [1, T, n_q, hd]
+            if quant:
+                return attn, (new_kp, new_vp, new_ks, new_vs)
+            return attn, (new_kp, new_vp)
+
+        h, cache = _block(cfg, h, p, cos, sin, attend)
+        return (h, li + 1), cache
+
+    if quant:
+        xs = (scan_layers, k_pages, v_pages, k_scales, v_scales)
+        (h, _), (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+            body, (h, 0), xs
+        )
+    else:
+        (h, _), (k_pages, v_pages) = jax.lax.scan(
+            body, (h, 0), (scan_layers, k_pages, v_pages)
+        )
+    h = rms_norm(h, params["norm"], cfg.rms_norm_eps)
+    # per-segment last-token hidden states: [1, T, d] -> [R, 1, d]
+    h = h[0, logits_at][:, None, :]
+    logits = _logits(params, h, int4_kernel=int4_kernel, w4a8=False)
+    if quant:
+        return logits, k_pages, v_pages, k_scales, v_scales
+    return logits, k_pages, v_pages

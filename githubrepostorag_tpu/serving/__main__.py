@@ -107,6 +107,7 @@ async def serve(host: str, port: int) -> None:
             max_seq_len=s.context_window,
             prefill_chunk=s.prefill_chunk,
             prefill_widths=s.prefill_widths,
+            prefill_token_budget=s.prefill_token_budget or None,
             use_pallas=jax.default_backend() == "tpu",
             kv_quant=s.kv_quant,
             mesh=mesh,

@@ -42,7 +42,8 @@ class AsyncEngine:
         # last engine-counter values already exported to prometheus —
         # instance state, so a stop()/start() relaunch doesn't re-export
         # the full cumulative totals
-        self._exported = {"hit": 0, "prop": 0, "acc": 0}
+        self._exported = {"hit": 0, "prop": 0, "acc": 0,
+                          "packed_tok": 0, "packed_pad": 0}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -66,6 +67,8 @@ class AsyncEngine:
             DECODE_TOKENS,
             ENGINE_RUNNING,
             ENGINE_WAITING,
+            PACKED_PREFILL_PADDING,
+            PACKED_PREFILL_TOKENS,
             PREFIX_CACHE_HITS,
             SPEC_ACCEPTED,
             SPEC_PROPOSED,
@@ -77,11 +80,16 @@ class AsyncEngine:
 
         def export_counters() -> None:
             hit = getattr(self.engine._allocator, "hit_tokens", 0)
+            ptok = getattr(self.engine, "packed_prefill_tokens", 0)
+            ppad = getattr(self.engine, "packed_prefill_padding", 0)
             PREFIX_CACHE_HITS.inc(hit - last["hit"])
             SPEC_PROPOSED.inc(self.engine.spec_proposed - last["prop"])
             SPEC_ACCEPTED.inc(self.engine.spec_accepted - last["acc"])
+            PACKED_PREFILL_TOKENS.inc(ptok - last["packed_tok"])
+            PACKED_PREFILL_PADDING.inc(ppad - last["packed_pad"])
             last.update(hit=hit, prop=self.engine.spec_proposed,
-                        acc=self.engine.spec_accepted)
+                        acc=self.engine.spec_accepted,
+                        packed_tok=ptok, packed_pad=ppad)
 
         while not self._stop:
             with self._lock:

@@ -34,13 +34,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from githubrepostorag_tpu.models.qwen2 import Qwen2Config, forward_paged
+from githubrepostorag_tpu.models.qwen2 import (
+    Qwen2Config,
+    forward_paged,
+    forward_paged_packed,
+)
 from githubrepostorag_tpu.ops.sampling import sample_tokens
 from githubrepostorag_tpu.serving.kv_cache import (
     OutOfPages,
     PageAllocator,
     PrefixCachingAllocator,
     make_page_pools,
+    packed_slot_mapping,
     page_hashes,
     pages_needed,
     slot_mapping,
@@ -112,6 +117,16 @@ class Engine:
         # 7B prefill wave computed on padding).  warmup() compiles every
         # (row bucket x width bucket) pair so live traffic stays on
         # warmed shapes.
+        prefill_token_budget: int | None = None,  # token-budget PACKED
+        # prefill: flatten every prefilling row's next chunk into one
+        # [budget] buffer with per-token segment IDs instead of the
+        # padded [row_bucket, width] dispatch.  Prefill dense-layer FLOPs
+        # scale with real tokens, not rows x max-chunk — the win on
+        # heterogeneous waves (mixed prompt lengths, tail chunks, short
+        # uncached suffixes after prefix-cache hits).  Chunks that don't
+        # fit the budget split mid-chunk and resume next step.  One
+        # compiled prefill shape per row bucket (the width-bucket zoo
+        # collapses; ``prefill_widths`` is ignored).  None = padded path.
         kv_dtype=jnp.bfloat16,
         kv_quant: bool = False,  # int8 KV pages with per-page scales —
         # halves cache reads and doubles page capacity
@@ -186,6 +201,17 @@ class Engine:
             if half < max(page_size, 16):
                 break
             self.prefill_width_buckets.append(half)
+        if prefill_token_budget is not None and prefill_token_budget < 1:
+            raise ValueError("prefill_token_budget must be >= 1 when set")
+        self.prefill_token_budget = prefill_token_budget
+        # static per-segment chunk cap in the packed buffer: no segment
+        # ever contributes more than a prefill chunk (or the whole budget)
+        self.packed_chunk = (
+            min(prefill_chunk, prefill_token_budget)
+            if prefill_token_budget is not None else 0
+        )
+        self.packed_prefill_tokens = 0  # stats: real tokens dispatched
+        self.packed_prefill_padding = 0  # stats: unused budget slots
         self.use_pallas = use_pallas
         # decode iterations fused per device dispatch (serving/decode_burst.py);
         # 1 reproduces plain per-token stepping
@@ -434,6 +460,24 @@ class Engine:
                 width = w
         return width
 
+    def packed_prefill_buckets(self) -> list[int]:
+        """The exact set of segment-count row buckets the packed prefill
+        can dispatch at — one compiled ``forward_paged_packed`` program per
+        entry, nothing else.  A dispatch packs at most
+        min(max_num_seqs, budget) segments (every segment carries >= 1
+        token), and segment counts bucket through the same ``_bucket``
+        call ``_prefill_batch_packed`` uses, so warmup() and live traffic
+        can never desynchronize."""
+        cap = min(self.max_num_seqs, self.prefill_token_budget or 0)
+        out: list[int] = []
+        b = 1
+        while cap:
+            out.append(_bucket(min(b, cap), self.max_num_seqs, minimum=1))
+            if b >= cap:
+                break
+            b *= 2
+        return list(dict.fromkeys(out))
+
     def _head_need_hashes(self, req: _Request) -> tuple[int, list[bytes]]:
         """Total page need for ``req`` and the chain hashes of the prefix
         pages an admission would be allowed to share (capped so at least one
@@ -562,6 +606,9 @@ class Engine:
         on-device call.  When decode is running, the sampled tokens are NOT
         fetched — the wave is queued on device and commits with the next
         burst, so admissions never stall running streams on a host sync."""
+        if self.prefill_token_budget is not None:
+            self._prefill_batch_packed(reqs, finished)
+            return
         others_running = any(r.state == "running" for r in self._row_req.values())
         n = len(reqs)
         # Shape discipline: row count buckets to powers of two, width comes
@@ -660,6 +707,132 @@ class Engine:
         if (self._chain is None and not others_running) or self.spec_ngram_k > 0:
             # engine idle (nothing to overlap the sync with) or speculative
             # mode (synchronous by design): commit immediately (best TTFT)
+            tokens = np.asarray(tokens_d)
+            for req, i in wave:
+                self._commit_token(req, int(tokens[i]), finished)
+        else:
+            self._pending_first.append((tokens_d, wave))
+
+    def _prefill_batch_packed(
+        self, reqs: list[_Request], finished: list[GenerationResult]
+    ) -> None:
+        """Token-budget packed prefill dispatch (``prefill_token_budget``).
+
+        Greedy packing: walk the prefilling rows in order and give each its
+        next chunk — whole, or split to whatever budget remains — until the
+        [budget] buffer is full.  Rows that don't fit wait for the next
+        step's dispatch (step() re-enters _try_prefill every iteration, so
+        nothing starves).  Per-token segment IDs carry each token's block
+        table / cached length into the segment-masked attention path
+        (ops/packed_prefill.py); first tokens sample at per-segment last
+        positions via the generalized ``logits_at``, and the
+        no-host-sync handoff into ``_pending_first``/the decode chain is
+        identical to the padded path.
+
+        Shape discipline: the token buffer is ALWAYS [1, budget]; only the
+        segment-count row bucket varies, so the compiled-prefill set is
+        exactly one program per bucket in packed_prefill_buckets() —
+        warmup() compiles each, live traffic adds none."""
+        others_running = any(r.state == "running" for r in self._row_req.values())
+        budget = self.prefill_token_budget
+        tq = self.packed_chunk
+        packed: list[tuple[_Request, int]] = []  # (request, tokens granted)
+        used = 0
+        for req in reqs:
+            if used >= budget:
+                break
+            share = min(len(req.prompt) - req.prefill_pos, tq, budget - used)
+            packed.append((req, share))
+            used += share
+        n = len(packed)
+        rb = _bucket(n, self.max_num_seqs, minimum=1)
+
+        ids = np.zeros((1, budget), dtype=np.int32)
+        pos = np.zeros((1, budget), dtype=np.int32)
+        slots = np.full((budget,), -1, dtype=np.int32)
+        seg = np.full((budget,), rb, dtype=np.int32)  # sentinel: padding
+        bt = np.zeros((rb, self.max_pages_per_seq), dtype=np.int32)
+        cached = np.zeros((rb,), dtype=np.int32)
+        new_lens = np.zeros((rb,), dtype=np.int32)
+        last_idx = np.zeros((rb,), dtype=np.int32)
+        # presence marking reuses the padded path's [row bucket, width]
+        # scatter at the fixed width tq — one shape per row bucket
+        seg_ids_2d = np.zeros((rb, tq), dtype=np.int32)
+        off = 0
+        for i, (req, share) in enumerate(packed):
+            start = req.prefill_pos
+            chunk = req.prompt[start : start + share]
+            ids[0, off : off + share] = chunk
+            pos[0, off : off + share] = np.arange(start, start + share)
+            packed_slot_mapping(
+                self._block_tables[req.row], start, share, self.page_size,
+                slots, off,
+            )
+            seg[off : off + share] = i
+            seg_ids_2d[i, :share] = chunk
+            bt[i] = self._block_tables[req.row]
+            cached[i] = start
+            new_lens[i] = share
+            last_idx[i] = off + share - 1
+            off += share
+        self.packed_prefill_tokens += used
+        self.packed_prefill_padding += budget - used
+
+        with annotate("engine.prefill_packed"):
+            out = forward_paged_packed(
+                self.params, self.cfg,
+                jnp.asarray(ids), jnp.asarray(pos),
+                self._k_pages, self._v_pages,
+                jnp.asarray(slots), jnp.asarray(bt),
+                jnp.asarray(cached), jnp.asarray(new_lens),
+                jnp.asarray(seg), jnp.asarray(last_idx),
+                tq=tq, use_pallas=self.use_pallas,
+                k_scales=self._k_scales, v_scales=self._v_scales,
+                int4_kernel=self._int4_kernel,
+            )
+            if self.kv_quant:
+                (logits, self._k_pages, self._v_pages,
+                 self._k_scales, self._v_scales) = out
+            else:
+                logits, self._k_pages, self._v_pages = out
+
+        row_idx = np.zeros((rb,), dtype=np.int32)
+        row_idx[:n] = [req.row for req, _ in packed]
+        row_d = jnp.asarray(row_idx)
+        self._presence = _mark_presence_chunks(
+            self._presence, row_d, jnp.asarray(seg_ids_2d),
+            jnp.asarray(new_lens), self.cfg.vocab_size,
+        )
+
+        done_idx: list[int] = []
+        for i, (req, share) in enumerate(packed):
+            req.prefill_pos += share
+            req.seq_len = req.prefill_pos
+            self._seq_lens[req.row] = req.seq_len
+            self._register_full_pages(req)
+            if req.prefill_pos >= len(req.prompt):
+                done_idx.append(i)
+
+        if not done_idx:
+            return
+
+        done_mask = np.zeros((rb,), dtype=bool)
+        done_mask[done_idx] = True
+
+        self._push_sampling()
+        self._rng, key = jax.random.split(self._rng)
+        last_logits = logits[:, 0]  # [rb, V] — logits_at already selected
+        tokens_d = sample_tokens(
+            last_logits, key,
+            self._temp_d[row_d], self._top_p_d[row_d], self._top_k_d[row_d],
+            self._rep_pen_d[row_d], self._presence[row_d],
+        )
+        safe = jnp.where(jnp.asarray(done_mask), tokens_d, self.cfg.vocab_size)
+        self._presence = _mark_presence_rows(self._presence, row_d, safe)
+        wave = [(packed[i][0], i) for i in done_idx]
+        for req, _ in wave:
+            req.state = "running"
+        if (self._chain is None and not others_running) or self.spec_ngram_k > 0:
             tokens = np.asarray(tokens_d)
             for req, i in wave:
                 self._commit_token(req, int(tokens[i]), finished)
@@ -1155,8 +1328,29 @@ class Engine:
         wave = 0  # distinct prompt content per wave: identical prompts
         # across waves would hit the prefix cache and resume PAST the
         # prefill program this wave is meant to compile
+        if self.prefill_token_budget is not None:
+            # packed prefill: the token buffer is always [1, budget], so
+            # the only varying axis is the segment-count row bucket — one
+            # wave per packed_prefill_buckets() entry compiles the whole
+            # packed shape set (nb can exceed the packable segment cap —
+            # the first dispatch then packs cap segments at exactly the
+            # bucket this entry names, and the leftovers re-dispatch at
+            # buckets earlier entries already compiled)
+            for nb in self.packed_prefill_buckets():
+                short_pages = pages_needed(3 + sp.max_tokens, self.page_size)
+                long_budget = (
+                    self._allocator.num_pages - (nb - 1) * short_pages
+                ) * self.page_size - sp.max_tokens
+                plen = min(self.prefill_chunk, self.max_seq_len - 3, long_budget)
+                if self.sp_prefill_threshold is not None and self._sp > 1:
+                    plen = min(plen, self.sp_prefill_threshold - 1)
+                if plen <= 0:
+                    continue  # unreachable bucket (see padded-path note)
+                wave += 1
+                tok = 2 + wave % max(2, self.cfg.vocab_size - 2)
+                self.generate([[tok] * plen] + [[tok] * 3] * (nb - 1), sp)
         seen: set[tuple[int, int]] = set()  # (row bucket, width) dispatched
-        for nb in buckets:
+        for nb in buckets if self.prefill_token_budget is None else []:
             for w in self.prefill_width_buckets:
                 # ONE long prompt selects width bucket w; the other nb-1
                 # rows stay short, so the page pool never forces the wave

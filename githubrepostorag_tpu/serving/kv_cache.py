@@ -330,3 +330,23 @@ def slot_mapping(
     out = np.full((pad_to,), -1, dtype=np.int32)
     out[:num_tokens] = slots
     return out
+
+
+def packed_slot_mapping(
+    block_table_row: np.ndarray,
+    start_pos: int,
+    num_tokens: int,
+    page_size: int,
+    out: np.ndarray,
+    offset: int,
+) -> None:
+    """Write one segment's flat pool slots for tokens
+    [start_pos, start_pos + num_tokens) into ``out[offset : offset +
+    num_tokens]`` — the packed-prefill variant of ``slot_mapping``, filling
+    a shared [budget] buffer (pre-initialized to -1 so unfilled tail
+    positions stay padding) instead of a per-row padded slice."""
+    positions = np.arange(start_pos, start_pos + num_tokens)
+    out[offset : offset + num_tokens] = (
+        block_table_row[positions // page_size] * page_size
+        + positions % page_size
+    )

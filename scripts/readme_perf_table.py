@@ -38,8 +38,9 @@ def load_driver_summary(root: pathlib.Path = ROOT,
     NEWER driver artifact landing between rounds doesn't fail CI — see
     tests/test_readme_table.py).  The driver keeps only the last ~2000
     chars of bench output, so the line may be truncated at the FRONT —
-    recover per-metric pairs by regex inside the summary object instead of
-    requiring valid JSON."""
+    possibly past the "bench_summary" key itself — recover per-metric
+    pairs by regex inside the summary object instead of requiring valid
+    JSON, and log any key whose value the regex can't parse."""
     candidates = ([root / name] if name else
                   sorted(root.glob("BENCH_r[0-9]*.json"), reverse=True))
     for path in candidates:
@@ -49,13 +50,32 @@ def load_driver_summary(root: pathlib.Path = ROOT,
             continue
         at = tail.rfind('"bench_summary"')
         if at == -1:
-            continue
-        seg = tail[at:]
+            # ~2000 chars of tail can cut the "bench_summary" KEY itself
+            # off a long summary (r05 did).  The summary line is the only
+            # compact ("k":v, no spaces) JSON in the bench output — the
+            # per-metric emit lines are space-separated — so when the
+            # tail's first line still closes the object, recover the
+            # surviving pairs from it.
+            seg = tail.split("\n", 1)[0]
+            if "}}" not in seg:
+                continue
+        else:
+            seg = tail[at:]
         close = seg.find("}}")
         if close != -1:
             seg = seg[:close]
-        pairs = re.findall(r'"([\w./-]+)":(-?\d+(?:\.\d+)?)', seg)
+        pairs = re.findall(
+            r'"([\w./-]+)":(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)', seg
+        )
         summary = {k: float(v) for k, v in pairs if k != "bench_summary"}
+        # a key the value regex can't parse (NaN, a nested object, a
+        # format this script predates) must be LOGGED, not silently
+        # dropped — a silently missing metric reads as "never measured"
+        unmatched = [k for k in re.findall(r'"([\w./-]+)":', seg)
+                     if k != "bench_summary" and k not in summary]
+        if unmatched:
+            print(f"readme_perf_table: unparsed keys in {path.name}: "
+                  f"{sorted(set(unmatched))}", file=sys.stderr)
         if summary:
             return path.name, summary
     return "", {}

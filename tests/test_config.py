@@ -14,6 +14,7 @@ def test_defaults_match_reference():
     assert s.context_window == 11712
     assert s.embeddings_table_chunk == "embeddings"
     assert s.embeddings_table_catalog == "embeddings_catalog"
+    assert s.prefill_token_budget == 0  # default: padded prefill dispatch
 
 
 def test_env_overrides(monkeypatch):
@@ -21,11 +22,13 @@ def test_env_overrides(monkeypatch):
     monkeypatch.setenv("EMBEDDINGS_TABLE", "alt_embeddings")
     monkeypatch.setenv("DEV_MODE", "true")
     monkeypatch.setenv("PREFILL_WIDTHS", "2")
+    monkeypatch.setenv("PREFILL_TOKEN_BUDGET", "2048")
     s = reload_settings()
     assert s.max_rag_attempts == 7
     assert s.embeddings_table_chunk == "alt_embeddings"
     assert s.dev_force_standalone is True
     assert s.prefill_widths == 2
+    assert s.prefill_token_budget == 2048
 
 
 def test_scope_tables_cover_all_five_levels():

@@ -94,3 +94,16 @@ def test_tp2_engine_with_quantized_params_token_identical():
         return [r.output_tokens for r in eng.generate([[1, 2, 3], [6, 5, 4]], sp)]
 
     assert run(make_mesh(MeshPlan(tp=2))) == run(None)
+
+
+def test_quantize_rejects_tree_with_no_known_projection_leaf():
+    """A renamed/foreign params tree must fail loudly: silently returning
+    it unquantized serves full-precision weights under an int8 config —
+    no error, 2x the HBM, and the miss only shows in a memory profile."""
+    import pytest
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    params["layers"] = {f"foreign_{k}": v for k, v in params["layers"].items()}
+    with pytest.raises(ValueError, match="no known projection leaf"):
+        quantize_qwen2_params(params)
