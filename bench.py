@@ -562,6 +562,96 @@ def bench_spec_decode_rag(cfg0) -> dict:
     return out
 
 
+def bench_retrieval_pair(tag: str, *, n_docs: int, dim: int, concurrency: int,
+                         queries_per_thread: int, k: int,
+                         trials: int = 3) -> dict:
+    """``retrieval_conc16``: per-query host retrieval vs the coalesced
+    device index on the SAME corpus and query set.  A = each of
+    ``concurrency`` threads encodes a batch of ONE and runs
+    ``MemoryVectorStore.search`` per query (the pre-PR3 agent path: 16
+    sessions pay 16 encoder dispatches + 16 full corpus scans, serialized
+    on the store lock).  B = the same threads submit through
+    ``RetrievalCoalescer`` over a warmed ``DeviceIndexedStore`` — waves of
+    up to ``concurrency`` run as ONE encoder forward + ONE bucketed
+    ``lax.top_k`` dispatch.  Emits aggregate QPS + p50 latency per path
+    and the coalesced/host speedup the acceptance gate reads; asserts
+    doc-id parity between the paths before timing anything."""
+    from concurrent.futures import ThreadPoolExecutor
+    from statistics import median
+
+    from githubrepostorag_tpu.embedding import HashingTextEncoder
+    from githubrepostorag_tpu.retrieval import DeviceIndexedStore, RetrievalCoalescer
+    from githubrepostorag_tpu.store.base import Doc
+    from githubrepostorag_tpu.store.memory import MemoryVectorStore
+
+    table = "bench_retrieval"
+    encoder = HashingTextEncoder(dim=dim)
+    rng = np.random.default_rng(17)
+    vecs = rng.standard_normal((n_docs, dim)).astype(np.float32)
+    docs = [Doc(f"d{i}", f"chunk {i}", {"namespace": "bench",
+                                        "repo": f"repo{i % 7}"}, vecs[i])
+            for i in range(n_docs)]
+    host = MemoryVectorStore()
+    host.upsert(table, docs)
+    dstore = DeviceIndexedStore(MemoryVectorStore(), k_bucket=max(16, k),
+                                max_wave=concurrency)
+    dstore.upsert(table, docs)
+    log(f"bench[{tag}]: warmup (compiles the query-bucket ladder)")
+    dstore.warmup()
+    coal = RetrievalCoalescer(dstore, encoder, max_wave=concurrency)
+
+    n_q = concurrency * queries_per_thread
+    queries = [" ".join(f"sym{rng.integers(0, 5000)}" for _ in range(12))
+               for _ in range(n_q)]
+    chunks = [queries[t::concurrency] for t in range(concurrency)]
+
+    # parity gate before any timing: both paths must return the same docs
+    for q in queries[:4]:
+        qv = encoder.encode([q], kind="query")[0]
+        a = [h.doc.doc_id for h in host.search(table, qv, k)]
+        b = [h.doc.doc_id for h in coal.search_text(table, q, k)[1]]
+        assert a == b, f"retrieval parity broke: {a} vs {b}"
+
+    def run(path: str) -> tuple[float, float]:
+        lats: list[float] = []
+
+        def worker(qs: list[str]) -> None:
+            for q in qs:
+                t0 = time.monotonic()
+                if path == "host":
+                    qv = encoder.encode([q], kind="query")[0]
+                    host.search(table, qv, k)
+                else:
+                    coal.search_text(table, q, k)
+                lats.append(time.monotonic() - t0)
+
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            list(pool.map(worker, chunks))
+        wall = time.monotonic() - t0
+        lats.sort()
+        return n_q / wall, lats[len(lats) // 2]
+
+    out = {}
+    for path in ("host", "coalesced"):
+        run(path)  # untimed warm pass: jit, encoder cache, thread spin-up
+        samples = sorted(run(path) for _ in range(trials))
+        qps = median(s[0] for s in samples)
+        p50 = median(s[1] for s in samples)
+        out[path] = (qps, p50)
+        emit(f"{tag}_qps_{path}", qps, "q/s", None,
+             trial_qps=[round(s[0], 1) for s in samples])
+        emit(f"{tag}_p50_ms_{path}", p50 * 1e3, "ms", None)
+        log(f"bench[{tag}]: {path} {qps:.0f} q/s agg, p50 {p50 * 1e3:.2f} ms "
+            f"({concurrency} threads x {queries_per_thread} queries, "
+            f"corpus {n_docs}x{dim})")
+    speedup = out["coalesced"][0] / max(out["host"][0], 1e-9)
+    emit(f"{tag}_coalesced_qps_speedup", speedup, "x", None)
+    log(f"bench[{tag}]: coalesced/host aggregate QPS {speedup:.2f}x")
+    coal.close()
+    return {"speedup": speedup, **{p: out[p] for p in out}}
+
+
 def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
     """Ingest embedding throughput (BASELINE.md asks to measure chunks/sec):
     e5-small geometry JAX BERT, length-bucketed batches."""
@@ -668,6 +758,32 @@ def _main() -> None:
         bench_promptheavy_pair(
             cfg, params_t, "conc64_promptheavy_tiny_cpu", streams=16,
             len_range=(16, 96), gen_tokens=8, geom=geom_t, packed_budget=64)
+        # retrieval A/B at the CPU scale the acceptance gate reads: the
+        # coalesced-device win is dispatch-count-relative (16 encodes + 16
+        # lock-serialized scans vs 1+1 per wave), so it shows on CPU too
+        before = len(_RECORDS)
+        ret = bench_retrieval_pair("retrieval_conc16_cpu", n_docs=32768,
+                                   dim=384, concurrency=16,
+                                   queries_per_thread=16, k=8)
+        recs = _RECORDS[before:]
+        try:
+            with open(os.path.join(os.path.dirname(__file__) or ".",
+                                   "BENCH_retrieval_cpu.json"), "w") as f:
+                json.dump({
+                    "scenario": ("retrieval_conc16 (CPU A/B; TPU item gated "
+                                 "in bench.py)"),
+                    "platform": "cpu",
+                    "note": (
+                        "per-query host retrieval vs coalesced device index "
+                        "on the same 32768x384 corpus, 16 threads x 16 "
+                        "queries, k=8, 3-trial medians. Coalesced/host "
+                        f"aggregate QPS: {ret['speedup']:.2f}x."),
+                    "records": recs,
+                    "summary": {r["metric"]: r["value"] for r in recs},
+                }, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as exc:
+            log(f"bench: could not write BENCH_retrieval_cpu.json ({exc})")
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------
@@ -1182,6 +1298,13 @@ def _main() -> None:
         llm.close()  # stop the drive thread so the engine's pools actually free
         del agent, llm, enge
         gc.collect()
+
+    # ---- device-resident retrieval: coalesced vs per-query host ----------
+    # (PR3 tentpole: on TPU the matmul+top_k runs on chip, so the same A/B
+    # measures dispatch amortization AND device placement together)
+    if budget_allows("retrieval-conc16", 120):
+        bench_retrieval_pair("retrieval_conc16", n_docs=65536, dim=384,
+                             concurrency=16, queries_per_thread=16, k=8)
 
     # ---- ingest embedding chunks/sec -------------------------------------
     if budget_allows("embed", 60):

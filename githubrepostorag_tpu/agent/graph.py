@@ -160,19 +160,30 @@ class GraphAgent:
         if (len(docs) < 3 or state.attempt > 0) and len(docs) < cap:
             expanded = self._expand_query(state.query, state.filters.get("repo"), state.scope)
             # collect every expansion candidate first, then rank — capping by
-            # insertion order would drop stronger docs from later queries
+            # insertion order would drop stronger docs from later queries.
+            # The whole expansion set goes out as ONE batched wave (one
+            # encoder forward + one seed dispatch) instead of per-query
+            # sequential retrievals.
             seen = {hash(d.text) for d in docs}
             extras: list[RetrievedDoc] = []
-            for alt in expanded:
-                try:
-                    for doc in retriever.retrieve(alt, state.filters,
-                                                  top_k=state.top_k):
-                        h = hash(doc.text)
-                        if h not in seen:
-                            seen.add(h)
-                            extras.append(doc)
-                except Exception as exc:  # noqa: BLE001 - expansion is best-effort
-                    logger.warning("expanded query %r failed: %s", alt, exc)
+            retrieve_many = getattr(retriever, "retrieve_many", None)
+            try:
+                if callable(retrieve_many):
+                    alt_lists = retrieve_many(expanded, state.filters,
+                                              top_k=state.top_k)
+                else:  # duck-typed retriever without the batched API
+                    alt_lists = [retriever.retrieve(alt, state.filters,
+                                                    top_k=state.top_k)
+                                 for alt in expanded]
+            except Exception as exc:  # noqa: BLE001 - expansion is best-effort
+                logger.warning("expanded queries %r failed: %s", expanded, exc)
+                alt_lists = []
+            for alt_docs in alt_lists:
+                for doc in alt_docs:
+                    h = hash(doc.text)
+                    if h not in seen:
+                        seen.add(h)
+                        extras.append(doc)
             extras.sort(key=lambda d: d.score, reverse=True)
             all_docs = (list(docs) + extras)[:cap]
             if len(all_docs) > original_count:

@@ -95,6 +95,23 @@ class Settings:
     embed_model: str = field(default_factory=lambda: os.getenv("EMBED_MODEL", "intfloat/e5-small-v2"))
     embed_dim: int = field(default_factory=lambda: _env_int("EMBED_DIM", 384))
 
+    # --- Retrieval (device index + query coalescing) ---
+    # "auto" = wrap the store in the device-resident top-k index
+    # (retrieval/device_index.py) when running on TPU; "on"/"off" force it.
+    # CPU auto stays off: per-bucket XLA compiles cost more than they save
+    # at dev scale, and tests construct DeviceIndexedStore explicitly.
+    device_index: str = field(default_factory=lambda: os.getenv("DEVICE_INDEX", "auto"))
+    # coalesce concurrent retrieve() calls into one encoder forward + one
+    # search dispatch per wave (retrieval/coalescer.py); a wave of one is
+    # identical to the direct path, so this defaults ON
+    retrieval_coalesce: bool = field(default_factory=lambda: _env_bool("RETRIEVAL_COALESCE", True))
+    # max queries per coalesced wave AND the top query-bucket the device
+    # index warms (power-of-two buckets 1..max_wave)
+    retrieval_max_wave: int = field(default_factory=lambda: _env_int("RETRIEVAL_MAX_WAVE", 16))
+    # static k for the jitted top-k program; requests with k above this
+    # fall back to the host store (counted in rag_device_index_searches_total)
+    device_index_k_bucket: int = field(default_factory=lambda: _env_int("DEVICE_INDEX_K_BUCKET", 16))
+
     # --- LLM serving (in-tree TPU engine; endpoint kept for split deploys) ---
     qwen_endpoint: str = field(default_factory=lambda: os.getenv("QWEN_ENDPOINT", "http://qwen:8000"))
     qwen_model: str = field(default_factory=lambda: os.getenv("QWEN_MODEL", "Qwen/Qwen2.5-3B-Instruct"))

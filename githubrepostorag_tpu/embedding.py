@@ -17,6 +17,7 @@ Two encoder backends behind one protocol:
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import re
 from typing import Literal, Protocol, Sequence
@@ -44,6 +45,15 @@ class TextEncoder(Protocol):
 _WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]+|[0-9]+")
 
 
+@functools.lru_cache(maxsize=1 << 16)
+def _hash_slot(tok: str, dim: int) -> tuple[int, float]:
+    """md5(token) -> (feature index, sign).  Token vocabularies are heavily
+    repeated across chunks of the same repo (and across test runs), so the
+    md5 is memoized module-wide rather than recomputed per encode call."""
+    digest = hashlib.md5(tok.encode("utf-8")).digest()
+    return int.from_bytes(digest[:4], "little") % dim, 1.0 if digest[4] & 1 else -1.0
+
+
 class HashingTextEncoder:
     """Signed feature hashing over words + bigrams, sublinear tf, L2 norm."""
 
@@ -62,9 +72,7 @@ class HashingTextEncoder:
             for tok in self._tokens(text):
                 counts[tok] = counts.get(tok, 0) + 1
             for tok, count in counts.items():
-                digest = hashlib.md5(tok.encode("utf-8")).digest()
-                idx = int.from_bytes(digest[:4], "little") % self.dim
-                sign = 1.0 if digest[4] & 1 else -1.0
+                idx, sign = _hash_slot(tok, self.dim)
                 out[i, idx] += sign * (1.0 + np.log(count))
             norm = np.linalg.norm(out[i])
             if norm > 0:

@@ -41,6 +41,25 @@ RETRIEVAL_HITS = Histogram(
     "rag_retrieval_hits", "Docs returned per retrieval", registry=REGISTRY,
     buckets=(0, 1, 2, 3, 5, 8, 10, 20),
 )
+RETRIEVAL_SECONDS = Histogram(
+    "rag_retrieval_seconds",
+    "Per-request retrieval latency through the coalescer (queue + encode + search)",
+    registry=REGISTRY,
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+)
+RETRIEVAL_WAVE_SIZE = Histogram(
+    "rag_retrieval_wave_size",
+    "Queries coalesced into one encoder forward + search dispatch",
+    registry=REGISTRY,
+    buckets=(1, 2, 4, 8, 16, 32),
+)
+DEVICE_INDEX_SEARCHES = Counter(
+    "rag_device_index_searches_total",
+    "Vector searches by execution path (device = fused on-accelerator top-k, "
+    "fallback = host store outside the warmed bucket contract)",
+    ["path"],
+    registry=REGISTRY,
+)
 TTFT = Histogram(
     "rag_ttft_seconds", "Time to first generated token", registry=REGISTRY,
     buckets=(0.1, 0.25, 0.5, 1.0, 1.5, 2.0, 3.0, 5.0, 10.0),

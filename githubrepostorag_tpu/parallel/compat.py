@@ -1,0 +1,30 @@
+"""Version-compat shims for the distributed fabric.
+
+``shard_map`` moved twice across the jax versions this tree supports:
+``jax.experimental.shard_map.shard_map`` (<= 0.4.x, replication checking
+via ``check_rep``) became ``jax.shard_map`` (>= 0.6, ``check_vma``).
+Callers write against the new spelling once, here, instead of each
+guessing — same shape as the ``pltpu.CompilerParams`` shim in
+ops/packed_prefill.py.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` when it exists, else the experimental spelling
+    with ``check_vma`` mapped onto the old ``check_rep`` kwarg."""
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        try:
+            return new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_vma=check_vma)
+        except TypeError:  # a middle version: new location, old kwarg
+            return new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check_vma)
+    from jax.experimental.shard_map import shard_map as old
+
+    return old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)

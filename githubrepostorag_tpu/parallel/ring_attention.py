@@ -119,7 +119,9 @@ def make_ring_attend(
 
         return lambda q, k, v: dense_attention(q, k, v, causal=causal, q_offset=0)
 
-    return jax.shard_map(
+    from githubrepostorag_tpu.parallel.compat import shard_map
+
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -2,10 +2,18 @@
 store (the rebuild of the reference's GraphRetriever-per-scope factory,
 rag_worker/src/worker/services/graph_rag_retrievers.py)."""
 
+from githubrepostorag_tpu.retrieval.coalescer import RetrievalCoalescer
+from githubrepostorag_tpu.retrieval.device_index import DeviceIndexedStore
 from githubrepostorag_tpu.retrieval.retrievers import (
     RetrievedDoc,
     RetrieverFactory,
     ScopeRetriever,
 )
 
-__all__ = ["RetrievedDoc", "RetrieverFactory", "ScopeRetriever"]
+__all__ = [
+    "DeviceIndexedStore",
+    "RetrievalCoalescer",
+    "RetrievedDoc",
+    "RetrieverFactory",
+    "ScopeRetriever",
+]

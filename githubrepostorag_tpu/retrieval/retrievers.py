@@ -109,24 +109,58 @@ class ScopeRetriever:
         scope: str,
         spec: ScopeSpec | None = None,
         table: str | None = None,
+        coalescer=None,  # RetrievalCoalescer: embed+seed via shared waves
     ) -> None:
         self.store = store
         self.encoder = encoder
         self.scope = scope
         self.spec = spec or SCOPE_SPECS[scope]
         self.table = table or get_settings().scope_tables[self.spec.table_key]
+        self.coalescer = coalescer
 
     def retrieve(self, query: str, filters: Mapping[str, str] | None = None,
                  top_k: int | None = None) -> list[RetrievedDoc]:
         """``top_k`` overrides the scope spec's result cap ``k`` for this
         call (per-request QueryRequest.top_k); the traversal fan-out
         (start_k/adjacent_k/depth) stays spec-driven."""
+        return self.retrieve_many([query], filters, top_k=top_k)[0]
+
+    def retrieve_many(
+        self,
+        queries: Sequence[str],
+        filters: Mapping[str, str] | None = None,
+        top_k: int | None = None,
+    ) -> list[list[RetrievedDoc]]:
+        """Batched retrieval: ONE encoder forward and ONE seed-search
+        dispatch for the whole query set (via the coalescer when wired, so
+        concurrent sessions share the same wave), then the graph traversal
+        runs its per-level fan-out as batched metadata lookups instead of
+        one store call per (node, edge)."""
+        queries = list(queries)
+        if not queries:
+            return []
         spec = self.spec
         cap = top_k if top_k and top_k > 0 else spec.k
-        qvec = self.encoder.encode([query], kind="query")[0]
         flt = dict(filters or {})
+        if self.coalescer is not None:
+            pairs = self.coalescer.search_many(
+                self.table, queries, spec.start_k, flt, kind="query"
+            )
+        else:
+            qvecs = self.encoder.encode(queries, kind="query")
+            seed_lists = self.store.search_batch(
+                self.table, qvecs, spec.start_k, [flt] * len(queries)
+            )
+            pairs = list(zip(qvecs, seed_lists))
+        # edge lookups repeat heavily across a wave's queries (expansions
+        # share repo/module values) — memoize per retrieve_many call
+        edge_cache: dict[tuple[tuple[str, str], ...], list] = {}
+        return [self._traverse(qvec, seeds, flt, cap, edge_cache)
+                for qvec, seeds in pairs]
 
-        seeds = self.store.search(self.table, qvec, spec.start_k, filter=flt)
+    def _traverse(self, qvec: np.ndarray, seeds, flt: Mapping[str, str],
+                  cap: int, edge_cache: dict) -> list[RetrievedDoc]:
+        spec = self.spec
         found: dict[str, RetrievedDoc] = {}
         vectors: dict[str, np.ndarray] = {}  # unit vectors, for MMR
 
@@ -147,7 +181,9 @@ class ScopeRetriever:
         qnorm = np.linalg.norm(qvec)
         frontier = list(found.values())
         for depth in range(1, spec.max_depth + 1):
-            next_frontier: list[RetrievedDoc] = []
+            # the whole level's fan-out as ONE batched metadata lookup
+            # (minus wave-cache hits), preserving (frontier, edge) order
+            wanted: list[tuple[tuple[str, str], ...]] = []
             for doc in frontier:
                 for edge_key in spec.edges:
                     edge_val = doc.metadata.get(edge_key)
@@ -155,21 +191,55 @@ class ScopeRetriever:
                         continue
                     edge_filter = dict(flt)
                     edge_filter[edge_key] = edge_val
-                    for adj in self.store.find_by_metadata(
-                        self.table, edge_filter, limit=spec.adjacent_k
-                    ):
-                        if adj.doc_id in found:
+                    key = tuple(sorted(edge_filter.items()))
+                    if key not in edge_cache and key not in wanted:
+                        wanted.append(key)
+            if wanted:
+                batches = self.store.find_by_metadata_batch(
+                    self.table, [dict(key) for key in wanted],
+                    limit=spec.adjacent_k,
+                )
+                edge_cache.update(zip(wanted, batches))
+
+            new_docs: list[tuple] = []  # (Doc, depth) in traversal order
+            claimed: set[str] = set()
+            for doc in frontier:
+                for edge_key in spec.edges:
+                    edge_val = doc.metadata.get(edge_key)
+                    if not edge_val:
+                        continue
+                    edge_filter = dict(flt)
+                    edge_filter[edge_key] = edge_val
+                    key = tuple(sorted(edge_filter.items()))
+                    for adj in edge_cache.get(key, ()):
+                        if adj.doc_id in found or adj.doc_id in claimed:
                             continue
-                        score = 0.0
-                        if adj.vector is not None and qnorm > 0:
-                            v = np.asarray(adj.vector, dtype=np.float32)
-                            vn = np.linalg.norm(v)
-                            if vn > 0:
-                                score = float(v @ qvec / (vn * qnorm))
-                        rd = RetrievedDoc(adj.doc_id, adj.text, dict(adj.metadata), score, depth=depth)
-                        found[adj.doc_id] = rd
-                        remember_vector(adj.doc_id, adj.vector)
-                        next_frontier.append(rd)
+                        claimed.add(adj.doc_id)
+                        new_docs.append(adj)
+
+            # score the level's candidates with ONE matmul (same formula as
+            # the old per-doc dot: v @ qvec / (|v| * |qvec|))
+            scores = np.zeros(len(new_docs), dtype=np.float32)
+            if qnorm > 0 and new_docs:
+                rows = [i for i, d in enumerate(new_docs) if d.vector is not None]
+                if rows:
+                    mat = np.stack([
+                        np.asarray(new_docs[i].vector, dtype=np.float32)
+                        for i in rows
+                    ])
+                    norms = np.linalg.norm(mat, axis=1)
+                    dots = mat @ np.asarray(qvec, dtype=np.float32)
+                    for i, dot, vn in zip(rows, dots, norms):
+                        if vn > 0:
+                            scores[i] = dot / (vn * qnorm)
+
+            next_frontier: list[RetrievedDoc] = []
+            for i, adj in enumerate(new_docs):
+                rd = RetrievedDoc(adj.doc_id, adj.text, dict(adj.metadata),
+                                  float(scores[i]), depth=depth)
+                found[adj.doc_id] = rd
+                remember_vector(adj.doc_id, adj.vector)
+                next_frontier.append(rd)
             frontier = next_frontier
             if not frontier:
                 break
@@ -183,20 +253,35 @@ class ScopeRetriever:
 class RetrieverFactory:
     """One retriever per scope over a shared store + encoder (the reference
     rebuilt a Cassandra session and HF embedder per factory; here both are
-    process-wide singletons)."""
+    process-wide singletons).  All scopes share ONE coalescer, so concurrent
+    sessions' retrievals merge into the same encode+search waves
+    (RETRIEVAL_COALESCE=0 restores the direct per-call path)."""
 
-    def __init__(self, store: VectorStore | None = None, encoder: TextEncoder | None = None) -> None:
+    def __init__(self, store: VectorStore | None = None,
+                 encoder: TextEncoder | None = None, coalescer=None) -> None:
+        """``coalescer``: None = build one when RETRIEVAL_COALESCE is on;
+        False = force the direct path; an instance = share it."""
         from githubrepostorag_tpu.store import get_store
 
         self.store = store or get_store()
         self.encoder = encoder or get_encoder()
+        s = get_settings()
+        if coalescer is None and s.retrieval_coalesce:
+            from githubrepostorag_tpu.retrieval.coalescer import RetrievalCoalescer
+
+            coalescer = RetrievalCoalescer(
+                self.store, self.encoder, max_wave=s.retrieval_max_wave
+            )
+        self.coalescer = coalescer or None
         self._cache: dict[str, ScopeRetriever] = {}
 
     def for_scope(self, scope: str) -> ScopeRetriever:
         if scope not in SCOPE_SPECS:
             raise KeyError(f"unknown scope {scope!r}; valid: {list(SCOPE_SPECS)}")
         if scope not in self._cache:
-            self._cache[scope] = ScopeRetriever(self.store, self.encoder, scope)
+            self._cache[scope] = ScopeRetriever(
+                self.store, self.encoder, scope, coalescer=self.coalescer
+            )
         return self._cache[scope]
 
     def retrieve(self, scope: str, query: str, filters: Mapping[str, str] | None = None,

@@ -85,6 +85,21 @@ class VectorStore(abc.ABC):
     ) -> list[SearchHit]:
         """Cosine ANN with optional exact-match metadata filter."""
 
+    def search_batch(
+        self,
+        table: str,
+        query_vectors: np.ndarray,
+        k: int,
+        filters: Sequence[Mapping[str, str] | None] | None = None,
+    ) -> list[list[SearchHit]]:
+        """Batched ANN: one call for a whole query wave.  The default loops
+        ``search`` (host backends); device-resident backends override this
+        with a single fused dispatch (retrieval/device_index.py)."""
+        qs = np.asarray(query_vectors, dtype=np.float32)
+        if filters is None:
+            filters = [None] * qs.shape[0]
+        return [self.search(table, q, k, filter=f) for q, f in zip(qs, filters)]
+
     @abc.abstractmethod
     def find_by_metadata(
         self,
@@ -94,6 +109,17 @@ class VectorStore(abc.ABC):
     ) -> list[Doc]:
         """Equality lookup on metadata entries (the graph-edge traversal
         primitive: SAI entries(metadata_s) index in the reference)."""
+
+    def find_by_metadata_batch(
+        self,
+        table: str,
+        filters: Sequence[Mapping[str, str]],
+        limit: int = 100,
+    ) -> list[list[Doc]]:
+        """Batched edge lookup: one call per hierarchy-traversal level
+        instead of one per (node, edge).  Default loops ``find_by_metadata``;
+        server backends can override with a multi-key query."""
+        return [self.find_by_metadata(table, f, limit) for f in filters]
 
     @abc.abstractmethod
     def get(self, table: str, doc_id: str) -> Doc | None: ...

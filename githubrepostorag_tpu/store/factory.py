@@ -26,21 +26,36 @@ def set_store(store: VectorStore) -> None:
     _store = store
 
 
+def _device_index_enabled(s) -> bool:
+    """DEVICE_INDEX=auto wraps the store on TPU only; on/off force it."""
+    mode = s.device_index.strip().lower()
+    if mode in {"on", "1", "true", "yes"}:
+        return True
+    if mode not in {"auto", ""}:
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 - no jax -> host store
+        return False
+
+
 def _build() -> VectorStore:
     s = get_settings()
     backend = s.store_backend.lower()
     if backend == "memory":
         from githubrepostorag_tpu.store.memory import MemoryVectorStore
 
-        return MemoryVectorStore(persist_dir=s.store_path or None)
-    if backend == "native":
+        store: VectorStore = MemoryVectorStore(persist_dir=s.store_path or None)
+    elif backend == "native":
         from githubrepostorag_tpu.store.native import NativeVectorStore
 
-        return NativeVectorStore(persist_dir=s.store_path or None)
-    if backend == "cassandra":
+        store = NativeVectorStore(persist_dir=s.store_path or None)
+    elif backend == "cassandra":
         from githubrepostorag_tpu.store.cassandra import CassandraVectorStore
 
-        return CassandraVectorStore(
+        store = CassandraVectorStore(
             hosts=[s.cassandra_host],
             port=s.cassandra_port,
             username=s.cassandra_username,
@@ -48,4 +63,22 @@ def _build() -> VectorStore:
             keyspace=s.cassandra_keyspace,
             embed_dim=s.embed_dim,
         )
-    raise ValueError(f"Unknown STORE_BACKEND: {s.store_backend!r}")
+    else:
+        raise ValueError(f"Unknown STORE_BACKEND: {s.store_backend!r}")
+    if _device_index_enabled(s):
+        import jax
+
+        from githubrepostorag_tpu.retrieval.device_index import DeviceIndexedStore
+
+        mesh = None
+        if jax.device_count() > 1:
+            from githubrepostorag_tpu.parallel import make_mesh, plan_for_devices
+
+            mesh = make_mesh(plan_for_devices(jax.device_count(), role="ingest"))
+        store = DeviceIndexedStore(
+            store,
+            mesh=mesh,
+            k_bucket=s.device_index_k_bucket,
+            max_wave=s.retrieval_max_wave,
+        )
+    return store

@@ -69,7 +69,7 @@ class MemoryVectorStore(VectorStore):
     ) -> list[SearchHit]:
         with self._lock:
             t = self._tables.get(table)
-            if t is None:
+            if t is None or k <= 0:
                 return []
             mat, ids = t.matrix()
             if mat.shape[0] == 0:
@@ -79,15 +79,40 @@ class MemoryVectorStore(VectorStore):
             if qn == 0:
                 return []
             scores = mat @ (q / qn)
-            order = np.argsort(-scores)
-            hits: list[SearchHit] = []
-            for idx in order:
-                doc = t.docs[ids[idx]]
-                if _match(doc.metadata, filter):
-                    hits.append(SearchHit(doc=doc, score=float(scores[idx])))
-                    if len(hits) >= k:
-                        break
-            return hits
+            if filter:
+                rows = np.array(
+                    [i for i, did in enumerate(ids)
+                     if _match(t.docs[did].metadata, filter)],
+                    dtype=np.int64,
+                )
+                if rows.size == 0:
+                    return []
+                cand = scores[rows]
+            else:
+                rows = None
+                cand = scores
+            # argpartition selects the k winners in O(n); the partial sort
+            # of just those k is the canonical tie order: score desc, then
+            # insertion (row) index asc — identical to the device index's
+            # lax.top_k, whose ties also break toward the lower row.
+            k_eff = min(k, cand.shape[0])
+            if k_eff < cand.shape[0]:
+                kth = cand[np.argpartition(-cand, k_eff - 1)[:k_eff]].min()
+                # ties AT the k boundary: argpartition keeps an arbitrary
+                # one, the canonical order keeps the lowest rows — rebuild
+                # the winner set from the boundary score (flatnonzero is
+                # ascending, so tied rows come out in insertion order)
+                sure = np.flatnonzero(cand > kth)
+                tied = np.flatnonzero(cand == kth)
+                part = np.concatenate([sure, tied[: k_eff - sure.size]])
+            else:
+                part = np.arange(cand.shape[0])
+            part = part[np.lexsort((part, -cand[part]))]
+            out_rows = part if rows is None else rows[part]
+            return [
+                SearchHit(doc=t.docs[ids[i]], score=float(scores[i]))
+                for i in out_rows
+            ]
 
     def find_by_metadata(
         self,

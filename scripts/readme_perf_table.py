@@ -155,6 +155,11 @@ def build_table(records: list[dict], driver_name: str,
          ["rag_e2e_3round_p50_s_qwen2-0.5b", "rag_e2e_llm_calls_per_query"], ""),
         ("Embedding (e5-small geometry)",
          ["embed_chunks_s_e5-small"], "chunks/s"),
+        ("Retrieval conc16 agg QPS, host / coalesced device (CPU A/B)",
+         ["retrieval_conc16_cpu_qps_host",
+          "retrieval_conc16_cpu_qps_coalesced"], "q/s"),
+        ("Retrieval conc16 coalesced-device speedup (CPU A/B)",
+         ["retrieval_conc16_cpu_coalesced_qps_speedup"], "×"),
         ("Qwen2-MoE 16-expert decode, bs=8 (beyond-reference)",
          ["decode_tok_s_per_chip_qwen2-moe-16e_bs8"], "tok/s"),
         ("Qwen2-MoE 16-expert INT8 decode, bs=8",
@@ -174,11 +179,19 @@ def render(root: pathlib.Path = ROOT, driver_name: str | None = None) -> str:
     "BENCH_r0N.json" = pin to that artifact; "" = render the no-driver
     table (a README committed when no artifact tail parsed)."""
     data = json.loads((root / "BENCH_SUMMARY.json").read_text())
+    records = list(data["records"])
+    # scenario artifacts ride along: the committed retrieval A/B
+    # (BENCH_retrieval_cpu.json, written by bench.py's CPU branch) carries
+    # metrics a TPU-run BENCH_SUMMARY.json doesn't — appended AFTER the
+    # summary records so the committed A/B wins any same-name collision
+    retrieval = root / "BENCH_retrieval_cpu.json"
+    if retrieval.exists():
+        records += json.loads(retrieval.read_text())["records"]
     if driver_name == "":
         name, driver = "", {}
     else:
         name, driver = load_driver_summary(root, name=driver_name)
-    return build_table(data["records"], name, driver)
+    return build_table(records, name, driver)
 
 
 def committed_driver_name(table_text: str) -> str | None:

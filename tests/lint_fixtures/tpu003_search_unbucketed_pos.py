@@ -1,0 +1,23 @@
+"""TPU003 positive: a deliberately UNBUCKETED device search.
+
+The anti-pattern retrieval/device_index.py's capacity/query buckets exist
+to prevent: corpus and query counts flow straight into jitted shapes, so
+every ingest (corpus grows by one) and every distinct wave size compiles
+a fresh XLA program — the recompile-per-request regime, not a warmable
+bucket set."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def unbucketed_search(corpus, query, n_live):
+    # the live-row count arrives as a traced scalar and becomes a shape:
+    # one compiled program PER CORPUS SIZE
+    mask = jnp.arange(n_live) >= 0
+    scores = corpus @ query
+    return jax.lax.top_k(jnp.where(mask, scores, -jnp.inf), 5)
+
+
+def search_api(corpus, query, docs):
+    # len() straight into the jitted search: recompiles on every upsert
+    return unbucketed_search(corpus, query, len(docs))

@@ -1,0 +1,310 @@
+"""Device-resident retrieval index: exact parity with the numpy store,
+warmup/bucket compile contract, coalesced waves, and fallback accounting.
+
+The parity bar is the ISSUE-3 acceptance criterion: on randomized corpora
+the device index must return IDENTICAL top-k ids to MemoryVectorStore
+(scores within fp32 tolerance), including metadata filters (shredded
+keys), empty tables, k > corpus size, deletions, and re-upserts — and the
+jitted search-program count must not move under live traffic after
+``warmup()`` (the PR-2 ``_cache_size`` house style).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from githubrepostorag_tpu.embedding import HashingTextEncoder
+from githubrepostorag_tpu.metrics import DEVICE_INDEX_SEARCHES
+from githubrepostorag_tpu.parallel import MeshPlan, make_mesh
+from githubrepostorag_tpu.retrieval import (
+    DeviceIndexedStore,
+    RetrievalCoalescer,
+    RetrieverFactory,
+)
+from githubrepostorag_tpu.store.base import Doc
+from githubrepostorag_tpu.store.memory import MemoryVectorStore
+
+DIM = 24
+
+
+def _mk_docs(rng, n, dim=DIM, vectorless_every=0):
+    docs = []
+    for i in range(n):
+        vec = None
+        if not vectorless_every or (i % vectorless_every):
+            vec = rng.normal(size=dim).astype(np.float32)
+        meta = {
+            "namespace": "default",
+            "repo": f"repo{i % 3}",
+            "module": f"mod{i % 5}",
+            "topics": f"t{i % 2}",
+            f"topics:t{i % 2}": "1",  # shredded entry, as ingest writes it
+        }
+        docs.append(Doc(f"d{i:04d}", f"text {i}", meta, vec))
+    return docs
+
+
+def _ids(hits):
+    return [h.doc.doc_id for h in hits]
+
+
+def _scores(hits):
+    return [h.score for h in hits]
+
+
+def _assert_parity(inner, dev, table, queries, ks, filters):
+    for q in queries:
+        for k in ks:
+            for flt in filters:
+                host = inner.search(table, q, k, filter=flt)
+                devh = dev.search(table, q, k, filter=flt)
+                assert _ids(host) == _ids(devh), (k, flt)
+                assert np.allclose(_scores(host), _scores(devh), atol=1e-5)
+
+
+@pytest.mark.parametrize("n_docs", [1, 7, 50, 130])
+def test_randomized_corpus_parity(n_docs):
+    rng = np.random.default_rng(n_docs)
+    inner = MemoryVectorStore()
+    inner.upsert("t", _mk_docs(rng, n_docs, vectorless_every=9))
+    dev = DeviceIndexedStore(inner, k_bucket=16, max_wave=8)
+    queries = [rng.normal(size=DIM).astype(np.float32) for _ in range(4)]
+    queries.append(np.zeros(DIM, dtype=np.float32))  # zero-norm -> no hits
+    _assert_parity(
+        inner, dev, "t", queries, ks=[1, 3, 16],
+        filters=[None, {"repo": "repo1"}, {"topics": "t0"},
+                 {"repo": "repo0", "topics": "t1"}, {"repo": "nope"}],
+    )
+
+
+def test_parity_k_exceeds_corpus_and_k_bucket():
+    rng = np.random.default_rng(3)
+    inner = MemoryVectorStore()
+    inner.upsert("t", _mk_docs(rng, 10))
+    dev = DeviceIndexedStore(inner, k_bucket=8)
+    q = rng.normal(size=DIM).astype(np.float32)
+    # k > corpus within the bucket: every row comes back, same order
+    assert _ids(dev.search("t", q, 8)) == _ids(inner.search("t", q, 8))
+    # k > k_bucket: host fallback, still exact parity and counted
+    before = DEVICE_INDEX_SEARCHES.labels(path="fallback")._value.get()
+    assert _ids(dev.search("t", q, 50)) == _ids(inner.search("t", q, 50))
+    assert DEVICE_INDEX_SEARCHES.labels(path="fallback")._value.get() == before + 1
+
+
+def test_empty_and_unknown_tables():
+    inner = MemoryVectorStore()
+    dev = DeviceIndexedStore(inner)
+    q = np.ones(DIM, dtype=np.float32)
+    assert dev.search("missing", q, 5) == []
+    inner.upsert("t", [Doc("v", "no vector yet", {"repo": "r"}, None)])
+    dev2 = DeviceIndexedStore(inner)
+    assert dev2.search("t", q, 5) == inner.search("t", q, 5) == []
+
+
+def test_tie_order_matches_host_canonical_order():
+    """Duplicate vectors: both paths order ties by insertion row — the
+    memory store's stable (-score, row) partial sort and lax.top_k's
+    lower-index preference agree."""
+    rng = np.random.default_rng(7)
+    inner = MemoryVectorStore()
+    v = rng.normal(size=DIM).astype(np.float32)
+    docs = [Doc(f"tie{i}", "same", {}, v.copy()) for i in range(6)]
+    docs += _mk_docs(rng, 5)
+    inner.upsert("t", docs)
+    dev = DeviceIndexedStore(inner)
+    expect = [f"tie{i}" for i in range(4)]
+    assert _ids(inner.search("t", v, 4)) == expect
+    assert _ids(dev.search("t", v, 4)) == expect
+
+
+def test_incremental_upsert_delete_reupsert_parity():
+    rng = np.random.default_rng(11)
+    inner = MemoryVectorStore()
+    dev = DeviceIndexedStore(inner, min_capacity=4)
+    q = rng.normal(size=DIM).astype(np.float32)
+    # grow one doc at a time across several capacity buckets
+    for i, doc in enumerate(_mk_docs(rng, 40)):
+        dev.upsert("t", [doc])
+        if i % 13 == 0:
+            assert _ids(dev.search("t", q, 10)) == _ids(inner.search("t", q, 10))
+    dev.delete("t", ["d0003", "d0010"])
+    assert _ids(dev.search("t", q, 10)) == _ids(inner.search("t", q, 10))
+    # re-upsert an existing id with a new vector: same row, same tie order
+    dev.upsert("t", [Doc("d0005", "updated", {"repo": "repo9"}, q.copy())])
+    host, devh = inner.search("t", q, 5), dev.search("t", q, 5)
+    assert _ids(host) == _ids(devh) and _ids(devh)[0] == "d0005"
+    # metadata filter now matches the updated row
+    assert _ids(dev.search("t", q, 5, filter={"repo": "repo9"})) == ["d0005"]
+
+
+def test_wraps_preexisting_inner_rows():
+    """Wrapping a store that already holds rows (persistence reload) seeds
+    the mirror from the inner store."""
+    rng = np.random.default_rng(13)
+    inner = MemoryVectorStore()
+    inner.upsert("t", _mk_docs(rng, 20))
+    dev = DeviceIndexedStore(inner)
+    q = rng.normal(size=DIM).astype(np.float32)
+    assert _ids(dev.search("t", q, 6)) == _ids(inner.search("t", q, 6))
+
+
+@pytest.mark.parametrize("plan", [MeshPlan(dp=8), MeshPlan(dp=2)])
+def test_sharded_parity_over_dp_mesh(plan):
+    """The dp-sharded program (local top-k -> all-gather -> merge) returns
+    the same ids/scores/tie-order as the host store on the virtual mesh."""
+    rng = np.random.default_rng(17)
+    inner = MemoryVectorStore()
+    docs = _mk_docs(rng, 60)
+    v = rng.normal(size=DIM).astype(np.float32)
+    docs += [Doc(f"tie{i}", "same", {}, v.copy()) for i in range(5)]
+    inner.upsert("t", docs)
+    dev = DeviceIndexedStore(inner, mesh=make_mesh(plan), k_bucket=16)
+    queries = [rng.normal(size=DIM).astype(np.float32) for _ in range(3)] + [v]
+    _assert_parity(inner, dev, "t", queries, ks=[1, 5, 16],
+                   filters=[None, {"repo": "repo2"}])
+
+
+def test_warmup_compiles_exact_bucket_set_and_traffic_adds_zero():
+    """House style from PR 2: warmup's compile count is exactly the bucket
+    set (query buckets 1..max_wave for the one capacity bucket), and mixed
+    live traffic afterwards adds ZERO programs."""
+    rng = np.random.default_rng(19)
+    inner = MemoryVectorStore()
+    inner.upsert("t", _mk_docs(rng, 50))
+    dev = DeviceIndexedStore(inner, k_bucket=16, max_wave=16)
+    assert dev.search_program_cache_size() == 0
+    dev.warmup()
+    warmed = dev.search_program_cache_size()
+    assert warmed == 5  # query buckets 1, 2, 4, 8, 16 x one capacity bucket
+    # live traffic: every query count 1..16, filters on and off, k varied
+    for nq in range(1, 17):
+        qs = rng.normal(size=(nq, DIM)).astype(np.float32)
+        dev.search_batch("t", qs, 1 + nq % 16)
+        dev.search_batch("t", qs, 4, [{"repo": "repo1"}] * nq)
+    assert dev.search_program_cache_size() == warmed
+    # upserts that stay inside the capacity bucket also add zero programs
+    dev.upsert("t", [Doc("late", "late doc", {}, rng.normal(size=DIM).astype(np.float32))])
+    dev.search("t", rng.normal(size=DIM).astype(np.float32), 3)
+    assert dev.search_program_cache_size() == warmed
+
+
+def test_device_path_counted():
+    rng = np.random.default_rng(23)
+    inner = MemoryVectorStore()
+    inner.upsert("t", _mk_docs(rng, 10))
+    dev = DeviceIndexedStore(inner)
+    before = DEVICE_INDEX_SEARCHES.labels(path="device")._value.get()
+    dev.search_batch("t", rng.normal(size=(3, DIM)).astype(np.float32), 2)
+    assert DEVICE_INDEX_SEARCHES.labels(path="device")._value.get() == before + 3
+
+
+# --------------------------------------------------------------- coalescer
+
+
+def _seed_corpus(store, enc, n=24):
+    texts = [f"alpha beta {i} gamma delta" for i in range(n)]
+    store.upsert("embeddings", [
+        Doc(f"c{i}", t, {"namespace": "default", "file_path": f"f{i % 4}",
+                         "module": f"m{i % 2}"},
+            enc.encode([t])[0])
+        for i, t in enumerate(texts)
+    ])
+
+
+def test_coalescer_matches_direct_path_under_concurrency():
+    enc = HashingTextEncoder(dim=64)
+    store = MemoryVectorStore()
+    _seed_corpus(store, enc)
+    co = RetrievalCoalescer(store, enc, max_wave=8)
+    results = {}
+
+    def caller(i):
+        _, hits = co.search_text("embeddings", f"alpha beta {i}", 3)
+        results[i] = _ids(hits)
+
+    threads = [threading.Thread(target=caller, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(16):
+        direct = store.search(
+            "embeddings", enc.encode([f"alpha beta {i}"], kind="query")[0], 3)
+        assert results[i] == _ids(direct)
+
+
+def test_coalescer_propagates_errors_and_recovers():
+    class Boom:
+        dim = 8
+
+        def __init__(self):
+            self.fail = True
+
+        def encode(self, texts, kind="passage"):
+            if self.fail:
+                raise RuntimeError("encoder down")
+            return np.ones((len(texts), 8), dtype=np.float32)
+
+    enc = Boom()
+    store = MemoryVectorStore()
+    co = RetrievalCoalescer(store, enc, max_wave=4)
+    with pytest.raises(RuntimeError, match="encoder down"):
+        co.search_text("embeddings", "q", 3)
+    enc.fail = False  # the drain thread must survive a failed wave
+    qvec, hits = co.search_text("embeddings", "q", 3)
+    assert hits == [] and qvec.shape == (8,)
+
+
+def test_retrieve_many_equals_sequential_retrieve():
+    """Batched fan-out must not change results: retrieve_many over a set of
+    queries returns exactly what per-query retrieve() returns."""
+    enc = HashingTextEncoder(dim=64)
+    store = MemoryVectorStore()
+    _seed_corpus(store, enc)
+    direct = RetrieverFactory(store, enc, coalescer=False)
+    assert direct.coalescer is None
+    coalesced = RetrieverFactory(store, enc)
+    assert coalesced.coalescer is not None
+    queries = [f"alpha beta {i}" for i in (1, 5, 9)]
+    flt = {"namespace": "default"}
+    for scope in ("chunk", "file"):
+        seq = [direct.for_scope(scope).retrieve(q, flt) for q in queries]
+        # rebuild retriever so the per-call edge cache starts cold
+        batched = coalesced.for_scope(scope).retrieve_many(queries, flt)
+        for a, b in zip(seq, batched):
+            assert [d.doc_id for d in a] == [d.doc_id for d in b]
+            assert [d.depth for d in a] == [d.depth for d in b]
+            np.testing.assert_allclose(
+                [d.score for d in a], [d.score for d in b], atol=1e-5)
+
+
+def test_retriever_factory_respects_coalesce_knob(monkeypatch):
+    from githubrepostorag_tpu.config import reload_settings
+
+    monkeypatch.setenv("RETRIEVAL_COALESCE", "0")
+    reload_settings()
+    enc = HashingTextEncoder(dim=32)
+    f = RetrieverFactory(MemoryVectorStore(), enc)
+    assert f.coalescer is None
+    monkeypatch.delenv("RETRIEVAL_COALESCE")
+    reload_settings()
+    f2 = RetrieverFactory(MemoryVectorStore(), enc)
+    assert f2.coalescer is not None
+
+
+def test_device_store_through_full_retriever_stack():
+    """End-to-end: coalescer over a DeviceIndexedStore — one wave drives the
+    batched device search; hierarchy results equal the pure-host stack."""
+    enc = HashingTextEncoder(dim=64)
+    host = MemoryVectorStore()
+    _seed_corpus(host, enc)
+    dev = DeviceIndexedStore(host, k_bucket=16, max_wave=8)
+    f_host = RetrieverFactory(host, enc)
+    f_dev = RetrieverFactory(dev, enc)
+    queries = [f"alpha beta {i}" for i in (2, 6, 11)]
+    flt = {"namespace": "default"}
+    host_out = f_host.for_scope("chunk").retrieve_many(queries, flt)
+    dev_out = f_dev.for_scope("chunk").retrieve_many(queries, flt)
+    for a, b in zip(host_out, dev_out):
+        assert [d.doc_id for d in a] == [d.doc_id for d in b]

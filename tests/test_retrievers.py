@@ -110,3 +110,49 @@ def test_mmr_scope_retriever_end_to_end():
     r = ScopeRetriever(store, enc, "chunk")
     docs = r.retrieve("how do I create a job?", {"namespace": "default"})
     assert docs and docs[0].doc_id == "c1"  # top relevance still leads
+
+
+def test_hashing_encoder_md5_cache_hits_and_parity():
+    """The module-level md5->(index, sign) LRU must not change encodings,
+    and repeated encodes of the same vocabulary must hit it."""
+    from githubrepostorag_tpu.embedding import _hash_slot
+
+    enc = HashingTextEncoder(dim=96)
+    _hash_slot.cache_clear()
+    first = enc.encode(["rebalance the kafka consumer group"] * 3)
+    info = _hash_slot.cache_info()
+    assert info.hits > 0  # texts 2 and 3 reuse text 1's tokens
+    again = enc.encode(["rebalance the kafka consumer group"])
+    assert _hash_slot.cache_info().misses == info.misses  # all cached now
+    np.testing.assert_array_equal(first[0], again[0])
+    # distinct dims hash to distinct slots (dim is part of the cache key)
+    enc2 = HashingTextEncoder(dim=7)
+    vec = enc2.encode(["rebalance"])[0]
+    assert vec.shape == (7,)
+
+
+def test_retrieve_many_batches_seed_search(monkeypatch):
+    """retrieve_many must issue ONE batched seed search for the whole query
+    set, not one search per query."""
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    _seed(store, enc)
+    calls = {"batch": 0, "single": 0}
+    orig_batch = store.search_batch
+    orig_single = store.search
+
+    def counting_batch(*a, **kw):
+        calls["batch"] += 1
+        return orig_batch(*a, **kw)
+
+    def counting_single(*a, **kw):
+        calls["single"] += 1
+        return orig_single(*a, **kw)
+
+    monkeypatch.setattr(store, "search_batch", counting_batch)
+    monkeypatch.setattr(store, "search", counting_single)
+    r = ScopeRetriever(store, enc, "chunk")
+    r.retrieve_many(["create a job", "cancel a job", "redis pubsub"],
+                    {"namespace": "default"})
+    assert calls["batch"] == 1
+    # the default search_batch loops search() internally; no EXTRA singles
+    assert calls["single"] == 3

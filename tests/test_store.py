@@ -135,3 +135,43 @@ def test_tech_synonym_topics_filter_retrieves_end_to_end():
     docs = r.retrieve("how does the kafka consumer rebalance?",
                       {"namespace": "default", "topics": "kafka"})
     assert [d.doc_id for d in docs][:1] == ["k1"]
+
+
+def test_topk_partial_sort_matches_full_sort_reference():
+    """The argpartition top-k path must return exactly what a full stable
+    sort by (-score, insertion row) returns — including duplicate-vector
+    ties — on randomized corpora, with and without filters."""
+    rng = np.random.default_rng(42)
+    store = MemoryVectorStore()
+    docs = []
+    for i in range(60):
+        vec = rng.normal(size=12).astype(np.float32)
+        if i % 7 == 0 and i:  # plant exact duplicates -> score ties
+            vec = np.asarray(docs[i - 1].vector).copy()
+        docs.append(Doc(f"d{i:03d}", f"text {i}", {"grp": str(i % 4)}, vec))
+    store.upsert("embeddings", docs)
+    mat, ids = store._tables["embeddings"].matrix()
+    for trial in range(5):
+        q = rng.normal(size=12).astype(np.float32)
+        scores = mat @ (q / np.linalg.norm(q))
+        for flt in (None, {"grp": "1"}):
+            rows = [i for i in range(len(ids))
+                    if flt is None or docs[i].metadata["grp"] == flt["grp"]]
+            # reference: FULL stable sort, score desc then row asc
+            ref = sorted(rows, key=lambda i: (-scores[i], i))[:9]
+            got = store.search("embeddings", q, k=9, filter=flt)
+            assert [h.doc.doc_id for h in got] == [ids[i] for i in ref]
+
+
+def test_tie_order_is_insertion_order():
+    store = MemoryVectorStore()
+    v = np.array([0.6, 0.8], dtype=np.float32)
+    store.upsert("embeddings", [Doc(f"t{i}", "same", {}, v.copy()) for i in range(5)])
+    hits = store.search("embeddings", v, k=3)
+    assert [h.doc.doc_id for h in hits] == ["t0", "t1", "t2"]
+
+
+def test_search_k_nonpositive_returns_empty():
+    store = MemoryVectorStore()
+    store.upsert("embeddings", [Doc("d0", "x", {}, np.array([1.0, 0.0], dtype=np.float32))])
+    assert store.search("embeddings", np.array([1.0, 0.0]), k=0) == []

@@ -66,6 +66,16 @@ def test_negative_fixtures_are_fully_clean():
         assert findings == [], f"{neg.name}: {[f.rule for f in findings]}"
 
 
+def test_tpu003_fires_on_unbucketed_search_fixture():
+    # the hazard retrieval/device_index.py's bucket contract exists to
+    # prevent: corpus/query counts flowing straight into jitted shapes
+    findings = analyze_file(FIXTURES / "tpu003_search_unbucketed_pos.py")
+    hits = [f for f in findings if f.rule == "TPU003"]
+    assert len(hits) >= 2  # traced shape AND len()-into-jit both caught
+    assert all(not f.suppressed for f in hits)
+    assert [f.rule for f in findings] == ["TPU003"] * len(findings)
+
+
 # -------------------------------------------------------------- suppressions
 
 def test_justified_suppression_silences_and_records_reason():
