@@ -32,6 +32,7 @@ from githubrepostorag_tpu.agent import prompts
 from githubrepostorag_tpu.agent.state import AgentState, ProgressCallback
 from githubrepostorag_tpu.config import get_settings
 from githubrepostorag_tpu.llm import LLM
+from githubrepostorag_tpu.resilience.policy import Deadline, DeadlineExceeded, deadline_scope
 from githubrepostorag_tpu.retrieval import RetrievedDoc, RetrieverFactory
 from githubrepostorag_tpu.retrieval.retrievers import SCOPE_LADDER
 from githubrepostorag_tpu.utils.json_utils import extract_json, truncate
@@ -384,6 +385,7 @@ class GraphAgent:
         should_stop: Callable[[], bool] | None = None,
         token_cb: Callable[[str], None] | None = None,
         top_k: int | None = None,
+        deadline: Deadline | None = None,
     ) -> AgentResult:
         state = AgentState(query=question, original_query=question,
                            progress_cb=progress_cb, top_k=top_k)
@@ -393,22 +395,28 @@ class GraphAgent:
         def check_cancel() -> None:
             if should_stop is not None and should_stop():
                 raise RunCancelled()
+            if deadline is not None and deadline.expired:
+                raise DeadlineExceeded("agent budget exhausted at a stage boundary")
 
-        check_cancel()
-        # force_level honored (the reference read it but ignored it —
-        # worker.py:101-107, SURVEY.md Appendix A) and skips the plan LLM call
-        self.plan_scope(state, force_level=force_level)
+        # the deadline rides a thread-local scope for the duration of the
+        # run so every llm.complete inside any stage sees the SAME budget
+        # without widening the LLM protocol signature
+        with deadline_scope(deadline):
+            check_cancel()
+            # force_level honored (the reference read it but ignored it —
+            # worker.py:101-107, SURVEY.md Appendix A) and skips the plan LLM call
+            self.plan_scope(state, force_level=force_level)
 
-        while True:
+            while True:
+                check_cancel()
+                self.retrieve(state)
+                check_cancel()
+                self.judge(state)
+                check_cancel()  # rewrite pays an LLM call; don't start it cancelled
+                if self.rewrite_or_end(state) == "synthesize":
+                    break
             check_cancel()
-            self.retrieve(state)
-            check_cancel()
-            self.judge(state)
-            check_cancel()  # rewrite pays an LLM call; don't start it cancelled
-            if self.rewrite_or_end(state) == "synthesize":
-                break
-        check_cancel()
-        self.synthesize(state, token_cb=token_cb)
+            self.synthesize(state, token_cb=token_cb)
         return AgentResult(answer=state.answer or "", sources=state.sources, debug=state.debug)
 
     # ------------------------------------------------------------ helpers

@@ -21,9 +21,11 @@ from pathlib import Path
 
 from aiohttp import web
 
+from githubrepostorag_tpu.config import get_settings
 from githubrepostorag_tpu.events.base import CancelFlags, JobQueue, ProgressBus
-from githubrepostorag_tpu.metrics import HTTP_LATENCY, HTTP_REQUESTS, render
+from githubrepostorag_tpu.metrics import HTTP_LATENCY, HTTP_REQUESTS, JOBS_SHED, render
 from githubrepostorag_tpu.models_dto import QueryRequest
+from githubrepostorag_tpu.resilience.policy import Deadline
 from githubrepostorag_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -117,8 +119,37 @@ class RagApi:
             req = QueryRequest(**body)
         except Exception as exc:  # noqa: BLE001
             return web.json_response({"error": f"invalid request: {exc}"}, status=400)
+        s = get_settings()
+        if req.deadline_ms is not None and (
+            isinstance(req.deadline_ms, bool) or req.deadline_ms <= 0
+        ):
+            return web.json_response(
+                {"error": "deadline_ms must be a positive integer"}, status=400
+            )
+        # backpressure: shed before enqueueing once the queue is saturated,
+        # so the client backs off instead of the backlog growing unbounded
+        try:
+            depth = await self.queue.depth()
+        except Exception:  # noqa: BLE001 - a flaky depth probe must not block intake
+            depth = 0
+        if depth >= s.job_queue_max_depth:
+            JOBS_SHED.inc()
+            retry_after = max(1, int(s.job_timeout_seconds // 10))
+            return web.json_response(
+                {"error": f"job queue full ({depth} queued); retry later"},
+                status=429,
+                headers={"Retry-After": str(retry_after)},
+            )
         job_id = uuid.uuid4().hex
-        await self.queue.enqueue_job("run_rag_job", job_id, req.model_dump(), _job_id=job_id)
+        cap_ms = s.job_timeout_seconds * 1000
+        budget_ms = min(req.deadline_ms or cap_ms, cap_ms)
+        await self.queue.enqueue_job(
+            "run_rag_job",
+            job_id,
+            req.model_dump(),
+            _job_id=job_id,
+            deadline=Deadline(budget_ms / 1000.0).to_wire(),
+        )
         return web.json_response({"job_id": job_id})
 
     async def job_events(self, request: web.Request) -> web.StreamResponse:
@@ -132,15 +163,55 @@ class RagApi:
             },
         )
         await resp.prepare(request)
+        import asyncio
+        import json as _json
+
+        heartbeat = get_settings().sse_heartbeat_seconds
+        it = self.bus.stream(job_id).__aiter__()
+        # the pending __anext__ must survive heartbeat timeouts: wait_for
+        # would cancel it, and cancelling an async generator's __anext__
+        # kills the generator mid-await
+        pending: asyncio.Task | None = None
         try:
-            async for frame in self.bus.stream(job_id):
+            while True:
+                if pending is None:
+                    pending = asyncio.ensure_future(it.__anext__())
+                done, _ = await asyncio.wait({pending}, timeout=heartbeat)
+                if not done:
+                    # comment frame: keeps proxies/LBs from idling the
+                    # connection out while the agent thinks
+                    await resp.write(b": heartbeat\n\n")
+                    continue
+                step, pending = pending, None
+                try:
+                    frame = step.result()
+                except StopAsyncIteration:
+                    break
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as exc:  # noqa: BLE001 - bus died mid-stream
+                    logger.exception("bus stream failed for %s", job_id)
+                    err = _json.dumps(
+                        {"event": "error", "data": {"error": f"event stream failed: {exc}"}}
+                    )
+                    await resp.write(f"data: {err}\n\n".encode())
+                    break
                 await resp.write(frame.encode())
                 # close the stream after the terminal event so EventSource
                 # clients do not reconnect forever
                 if '"event": "final"' in frame or '"event": "error"' in frame:
                     break
         except (ConnectionError, OSError):
-            pass
+            pass  # client went away; nothing left to tell it
+        finally:
+            if pending is not None:
+                pending.cancel()
+            aclose = getattr(it, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:  # noqa: BLE001
+                    pass
         return resp
 
     async def cancel_job(self, request: web.Request) -> web.Response:
@@ -160,11 +231,17 @@ class RagApi:
 
         from githubrepostorag_tpu.api.health import health_report
 
+        # queue depth is async-only (RESP round trip); resolve it here and
+        # hand the value to the sync report
+        try:
+            queue_depth = await self.queue.depth()
+        except Exception:  # noqa: BLE001
+            queue_depth = None
         # health probes do blocking I/O (HTTP to the LLM backend, store
         # connectivity); keep them off the event loop so SSE streams and
         # enqueues never stall behind a slow probe
         payload, status = await asyncio.get_running_loop().run_in_executor(
-            _HEALTH_POOL, health_report
+            _HEALTH_POOL, lambda: health_report(queue_depth=queue_depth)
         )
         return web.json_response(payload, status=status)
 

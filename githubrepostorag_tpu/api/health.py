@@ -102,13 +102,36 @@ def _system_component() -> dict:
         return {"status": "UP", "details": {"error": str(exc)}}
 
 
-def health_report() -> tuple[dict, int]:
-    """-> (payload, http_status).  503 when store or LLM is DOWN."""
+def _resilience_component(queue_depth: int | None) -> dict:
+    """Breaker states, queue depth, and in-flight jobs.  DOWN while any
+    circuit is open: the pod is refusing work on that dependency, so load
+    balancers should steer traffic elsewhere until the breaker half-opens."""
+    try:
+        from githubrepostorag_tpu.metrics import JOBS_IN_FLIGHT, counter_value
+        from githubrepostorag_tpu.resilience.policy import breaker_states
+
+        breakers = breaker_states()
+        any_open = any(b["state"] == "open" for b in breakers.values())
+        details: dict = {
+            "breakers": breakers,
+            "jobs_in_flight": int(counter_value(JOBS_IN_FLIGHT)),
+        }
+        if queue_depth is not None:
+            details["queue_depth"] = queue_depth
+        return {"status": "DOWN" if any_open else "UP", "details": details}
+    except Exception as exc:  # noqa: BLE001
+        return {"status": "UP", "details": {"error": str(exc)}}
+
+
+def health_report(queue_depth: int | None = None) -> tuple[dict, int]:
+    """-> (payload, http_status).  503 when store, LLM, or resilience (an
+    open circuit breaker) is DOWN."""
     components = {
         "vectorStore": _store_component(),
         "llm": _llm_component(),
         "system": _system_component(),
+        "resilience": _resilience_component(queue_depth),
     }
-    required = ("vectorStore", "llm")
+    required = ("vectorStore", "llm", "resilience")
     overall = "UP" if all(components[c]["status"] == "UP" for c in required) else "DOWN"
     return {"status": overall, "components": components}, (200 if overall == "UP" else 503)

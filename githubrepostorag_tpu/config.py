@@ -67,6 +67,30 @@ class Settings:
     # --- Event bus / job queue (Redis-compatible; in-memory fake for tests) ---
     redis_url: str = field(default_factory=lambda: os.getenv("REDIS_URL", "redis://redis-master:6379/0"))
     sse_ping_seconds: int = field(default_factory=lambda: _env_int("SSE_PING_SECONDS", 15))
+    # API-side SSE heartbeat: a ``: heartbeat`` comment frame is written
+    # whenever the bus stream stays silent this long, so proxies and
+    # EventSource clients never see a dead-quiet connection even when the
+    # bus itself is wedged (bus pings stop when its connection dies)
+    sse_heartbeat_seconds: float = field(default_factory=lambda: _env_float("SSE_HEARTBEAT_SECONDS", 15.0))
+
+    # --- Resilience (resilience/ package) ---
+    # admission bound: create_job sheds with 429 + Retry-After once the
+    # queue holds this many undequeued jobs
+    job_queue_max_depth: int = field(default_factory=lambda: _env_int("JOB_QUEUE_MAX_DEPTH", 256))
+    # jittered-exponential retry schedule for supervised paths (bus emit,
+    # worker dequeue): delay(n) = uniform(d/2, d), d = min(cap, base*2^n)
+    retry_max_attempts: int = field(default_factory=lambda: _env_int("RETRY_MAX_ATTEMPTS", 4))
+    retry_base_seconds: float = field(default_factory=lambda: _env_float("RETRY_BASE_SECONDS", 0.05))
+    retry_cap_seconds: float = field(default_factory=lambda: _env_float("RETRY_CAP_SECONDS", 2.0))
+    # per-dependency circuit breakers: open after N consecutive failures,
+    # probe again after reset_seconds (resilience/policy.py)
+    breaker_failure_threshold: int = field(default_factory=lambda: _env_int("BREAKER_FAILURE_THRESHOLD", 5))
+    breaker_reset_seconds: float = field(default_factory=lambda: _env_float("BREAKER_RESET_SECONDS", 30.0))
+    # deterministic fault injection spec, e.g.
+    # "redis.send:drop@3;cql.exchange:error@0.5;llm.complete:delay=2"
+    # (resilience/faults.py; empty = injection compiled out of the hot path)
+    faults: str = field(default_factory=lambda: os.getenv("FAULTS", ""))
+    faults_seed: int = field(default_factory=lambda: _env_int("FAULTS_SEED", 0))
 
     # --- Agent loop budget ---
     max_rag_attempts: int = field(default_factory=lambda: _env_int("MAX_RAG_ATTEMPTS", 3))

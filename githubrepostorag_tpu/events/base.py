@@ -91,3 +91,9 @@ class JobQueue(abc.ABC):
 
     @abc.abstractmethod
     async def get_result(self, job_id: str) -> Any: ...
+
+    async def depth(self) -> int:
+        """Jobs enqueued but not yet dequeued — the admission bound's input
+        (api/app.py create_job sheds at JOB_QUEUE_MAX_DEPTH).  Default 0:
+        a queue that can't report depth never sheds."""
+        return 0

@@ -26,6 +26,7 @@ from githubrepostorag_tpu.events.base import (
     encode_event,
     sse_frame,
 )
+from githubrepostorag_tpu.resilience.faults import InjectedFault, fire_async
 
 _REPLAY_LIMIT = 256
 
@@ -72,6 +73,12 @@ class MemoryBus(ProgressBus):
         self._ping_interval = ping_interval
 
     async def emit(self, job_id: str, event: str, data: dict[str, Any]) -> None:
+        # ``bus.emit`` injection seam: drop and error both raise so the
+        # supervised emit path (resilience.ResilientBus) sees the failure,
+        # retries, and counts what it ultimately loses — a fault that
+        # silently vanished here could never be "counted, never silent"
+        if await fire_async("bus.emit"):
+            raise InjectedFault("injected drop at bus.emit")
         payload = encode_event(event, data)
         now = time.monotonic()
         self._hub.prune(now)
@@ -130,6 +137,9 @@ class MemoryJobQueue(JobQueue):
 
     async def dequeue(self) -> EnqueuedJob:
         return await self._hub.queue.get()
+
+    async def depth(self) -> int:
+        return self._hub.queue.qsize()
 
     async def set_result(self, job_id: str, result: Any) -> None:
         self._prune()

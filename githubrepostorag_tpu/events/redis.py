@@ -29,6 +29,12 @@ from githubrepostorag_tpu.events.base import (
     sse_frame,
 )
 from githubrepostorag_tpu.events.resp import RespConnection
+from githubrepostorag_tpu.metrics import BUS_RECONNECTS
+from githubrepostorag_tpu.resilience.faults import InjectedFault, fire_async
+from githubrepostorag_tpu.resilience.policy import RetryPolicy
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 _QUEUE_KEY = "rag:jobs:queue"
 
@@ -40,26 +46,50 @@ class RedisBus(ProgressBus):
         self._ping_interval = ping_interval
 
     async def emit(self, job_id: str, event: str, data: dict[str, Any]) -> None:
+        # ``bus.emit`` seam mirrors the memory bus: the fault surfaces as a
+        # raised error for the supervised emit path to retry/count.  The
+        # RESP layer has its own redis.send/recv seams underneath.
+        if await fire_async("bus.emit"):
+            raise InjectedFault("injected drop at bus.emit")
         await self._cmd.command("PUBLISH", channel_for(job_id), encode_event(event, data))
 
     async def stream(self, job_id: str) -> AsyncIterator[str]:
+        """Subscribe and yield frames, re-subscribing with jittered backoff
+        when the connection dies.  Pub/sub has no replay: events published
+        during the gap are lost (counted via rag_bus_reconnects_total; the
+        worker's supervised emit keeps terminal events retrying so a
+        reconnected subscriber still learns how the job ended via the
+        result key even if it missed the final frame)."""
         import asyncio
 
-        conn = RespConnection(self._url)
-        await conn.connect()
-        await conn.send("SUBSCRIBE", channel_for(job_id))
-        await conn.read_reply()  # subscribe ack
-        try:
-            while True:
-                try:
-                    reply = await asyncio.wait_for(conn.read_reply(), timeout=self._ping_interval)
-                except asyncio.TimeoutError:
-                    yield PING_FRAME
-                    continue
-                if isinstance(reply, list) and len(reply) == 3 and reply[0] == "message":
-                    yield sse_frame(reply[2])
-        finally:
-            await conn.close()
+        policy = RetryPolicy.from_settings()
+        failures = 0
+        while True:
+            conn = RespConnection(self._url)
+            try:
+                await conn.connect()
+                await conn.send("SUBSCRIBE", channel_for(job_id))
+                await conn.read_reply()  # subscribe ack
+                failures = 0
+                while True:
+                    try:
+                        reply = await asyncio.wait_for(conn.read_reply(), timeout=self._ping_interval)
+                    except asyncio.TimeoutError:
+                        yield PING_FRAME
+                        continue
+                    if isinstance(reply, list) and len(reply) == 3 and reply[0] == "message":
+                        yield sse_frame(reply[2])
+            except (ConnectionError, OSError):
+                BUS_RECONNECTS.inc()
+                delay = policy.delay_for(failures)
+                failures += 1
+                logger.warning(
+                    "bus stream for %s lost its connection; re-subscribing in %.2fs",
+                    job_id, delay,
+                )
+                await asyncio.sleep(delay)
+            finally:
+                await conn.close()
 
     async def close(self) -> None:
         await self._cmd.close()
@@ -105,6 +135,10 @@ class RedisJobQueue(JobQueue):
                 args=tuple(raw.get("args", ())),
                 kwargs=raw.get("kwargs", {}),
             )
+
+    async def depth(self) -> int:
+        reply = await self._cmd.command("LLEN", _QUEUE_KEY)
+        return int(reply or 0)
 
     async def set_result(self, job_id: str, result: Any) -> None:
         await self._cmd.command(

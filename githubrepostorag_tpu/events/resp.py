@@ -64,23 +64,48 @@ class RespConnection:
         return self._writer is not None and not self._writer.is_closing()
 
     async def command(self, *args: str | bytes | int | float):
-        """Send one command and read one reply."""
+        """Send one command and read one reply.  A connection-level failure
+        mid-exchange tears the socket down before propagating, so the next
+        command reconnects instead of reading a misaligned stream."""
         async with self._lock:
             if not self.connected:
                 await self.connect()
-            self._writer.write(_encode_command(*args))
-            await self._writer.drain()
-            return await self.read_reply()
+            try:
+                await self._fire_faults()
+                self._writer.write(_encode_command(*args))
+                await self._writer.drain()
+                return await self.read_reply()
+            except (ConnectionError, OSError):
+                await self.close()
+                raise
 
     async def send(self, *args: str | bytes | int | float) -> None:
         """Send without reading a reply (subscribe-mode writes)."""
         async with self._lock:
             if not self.connected:
                 await self.connect()
-            self._writer.write(_encode_command(*args))
-            await self._writer.drain()
+            try:
+                await self._fire_faults()
+                self._writer.write(_encode_command(*args))
+                await self._writer.drain()
+            except (ConnectionError, OSError):
+                await self.close()
+                raise
+
+    async def _fire_faults(self) -> None:
+        """``redis.send`` injection seam (resilience/faults.py).  A drop
+        simulates the peer vanishing mid-write: raise ConnectionError and
+        let the caller's close-on-error path mark the socket dead."""
+        from githubrepostorag_tpu.resilience.faults import fire_async
+
+        if await fire_async("redis.send"):
+            raise ConnectionError("injected drop at redis.send")
 
     async def read_reply(self):
+        from githubrepostorag_tpu.resilience.faults import fire_async
+
+        if await fire_async("redis.recv"):
+            raise ConnectionError("injected drop at redis.recv")
         line = await self._reader.readline()
         if not line:
             raise ConnectionError("redis connection closed")

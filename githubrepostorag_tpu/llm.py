@@ -24,9 +24,12 @@ from __future__ import annotations
 import asyncio
 import re
 import threading
+import time
 from typing import Callable, Iterator, Protocol, Sequence
 
 from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.resilience.faults import fire_sync
+from githubrepostorag_tpu.resilience.policy import current_deadline, get_breaker
 from githubrepostorag_tpu.utils.json_utils import extract_choice, sanitize_llm_text, strip_fences
 from githubrepostorag_tpu.utils.logging import get_logger
 
@@ -51,6 +54,20 @@ def postprocess_completion(prompt: str, text: str) -> str:
 
 
 _postprocess = postprocess_completion
+
+
+def _llm_preamble() -> str | None:
+    """Shared entry gate for every backend's ``complete``: the
+    ``llm.complete`` fault seam plus the deadline check.  Returns error
+    text (the "errors travel as text, never raise" contract) when the call
+    must not proceed; InjectedFault from an ``error`` action propagates so
+    callers exercise their real exception paths."""
+    if fire_sync("llm.complete"):
+        return "Error: injected drop at llm.complete"
+    deadline = current_deadline()
+    if deadline is not None and deadline.expired:
+        return "Error: deadline exceeded before LLM call"
+    return None
 
 
 class LLM(Protocol):
@@ -90,6 +107,9 @@ class FakeLLM:
     def complete(self, prompt, *, system=None, max_tokens=None, temperature=None) -> str:
         self.calls.append({"prompt": prompt, "system": system,
                            "max_tokens": max_tokens, "temperature": temperature})
+        gate = _llm_preamble()
+        if gate is not None:
+            return gate
         for pattern, response in self.script.items():
             if re.search(pattern, prompt, re.DOTALL | re.IGNORECASE):
                 text = response(prompt) if callable(response) else response
@@ -198,20 +218,42 @@ class InProcessLLM:
             stop_token_ids=(self.tokenizer.eos_token_id,),
         )
 
+    @staticmethod
+    def _deadline_budget() -> tuple[float | None, float]:
+        """-> (engine deadline_s, caller-side timeout).  The engine gets an
+        absolute monotonic deadline so it can reap the row itself at a step
+        boundary (freeing KV pages); the thread-side fut.result timeout is
+        the remaining budget plus slack — a backstop, never the primary
+        enforcement, so expired requests normally come back as a reaped
+        result instead of an abandoned engine row."""
+        timeout = float(get_settings().job_timeout_seconds)
+        deadline = current_deadline()
+        if deadline is None:
+            return None, timeout
+        remaining = deadline.remaining()
+        return time.monotonic() + remaining, min(timeout, remaining + 5.0)
+
     def complete(self, prompt, *, system=None, max_tokens=None, temperature=None) -> str:
+        gate = _llm_preamble()
+        if gate is not None:
+            return gate
         loop = self._ensure_loop()
+        deadline_s, timeout = self._deadline_budget()
         fut = asyncio.run_coroutine_threadsafe(
             self.engine.generate(self._prompt_ids(prompt, system),
-                                 self._sampling(max_tokens, temperature)),
+                                 self._sampling(max_tokens, temperature),
+                                 deadline_s=deadline_s),
             loop,
         )
         try:
-            result = fut.result(timeout=get_settings().job_timeout_seconds)
+            result = fut.result(timeout=timeout)
         except Exception as exc:  # noqa: BLE001 - errors travel as text
             logger.error("InProcessLLM error: %s", exc)
             return f"Error: {exc}"
         if result.finish_reason == "error":
             return f"Error: {result.error}"
+        if result.finish_reason == "deadline":
+            return "Error: deadline exceeded (engine reaped the request)"
         return _postprocess(prompt, self.tokenizer.decode(result.output_tokens))
 
     def complete_batch(self, prompts: Sequence[str], *, system=None,
@@ -221,17 +263,22 @@ class InProcessLLM:
         extractors, BASELINE config #4), instead of one round-trip each."""
         loop = self._ensure_loop()
         sampling = self._sampling(max_tokens, temperature)
+        deadline_s, base_timeout = self._deadline_budget()
 
         async def run_all():
             return await asyncio.gather(
-                *(self.engine.generate(self._prompt_ids(p, system), sampling) for p in prompts),
+                *(self.engine.generate(self._prompt_ids(p, system), sampling,
+                                       deadline_s=deadline_s) for p in prompts),
                 return_exceptions=True,
             )
 
         fut = asyncio.run_coroutine_threadsafe(run_all(), loop)
         # budget scales with batch size (continuous batching overlaps them,
-        # but a loaded engine still serializes some decode time)
-        timeout = get_settings().job_timeout_seconds * max(1, -(-len(prompts) // 8))
+        # but a loaded engine still serializes some decode time); a live
+        # deadline overrides — the batch shares the request's one budget
+        timeout = base_timeout if deadline_s is not None else (
+            get_settings().job_timeout_seconds * max(1, -(-len(prompts) // 8))
+        )
         try:
             results = fut.result(timeout=timeout)
         except Exception as exc:  # noqa: BLE001
@@ -244,6 +291,8 @@ class InProcessLLM:
                 out.append(f"Error: {res}")
             elif res.finish_reason == "error":
                 out.append(f"Error: {res.error}")
+            elif res.finish_reason == "deadline":
+                out.append("Error: deadline exceeded (engine reaped the request)")
             else:
                 out.append(_postprocess(prompt, self.tokenizer.decode(res.output_tokens)))
         return out
@@ -252,12 +301,20 @@ class InProcessLLM:
                         temperature=None, on_text=None) -> Iterator[str]:
         from githubrepostorag_tpu.serving.tokenizer import StreamingDetokenizer
 
+        gate = _llm_preamble()
+        if gate is not None:
+            if on_text:
+                on_text(gate)
+            yield gate
+            return
         loop = self._ensure_loop()
+        deadline_s, _ = self._deadline_budget()
 
         async def pump():
             detok = StreamingDetokenizer(self.tokenizer)
             async for event in self.engine.stream(self._prompt_ids(prompt, system),
-                                                  self._sampling(max_tokens, temperature)):
+                                                  self._sampling(max_tokens, temperature),
+                                                  deadline_s=deadline_s):
                 if event.type == "token":
                     delta = detok.push(event.token_id)
                     if delta:
@@ -295,6 +352,14 @@ class HTTPLLM:
     def complete(self, prompt, *, system=None, max_tokens=None, temperature=None) -> str:
         import requests
 
+        gate = _llm_preamble()
+        if gate is not None:
+            return gate
+        # per-dependency breaker: a flapping endpoint fails fast (and shows
+        # DOWN in /health) instead of stacking request timeouts
+        breaker = get_breaker("llm.http")
+        if not breaker.allow():
+            return "Error: circuit llm.http is open (endpoint failing; backing off)"
         s = get_settings()
         messages = []
         if system:
@@ -314,8 +379,10 @@ class HTTPLLM:
             resp.raise_for_status()
             text = resp.json()["choices"][0]["message"]["content"]
         except Exception as exc:  # noqa: BLE001 - errors travel as text
+            breaker.record_failure()
             logger.error("HTTPLLM error: %s", exc)
             return f"Error: {exc}"
+        breaker.record_success()
         return _postprocess(prompt, text)
 
     def stream_complete(self, prompt, *, system=None, max_tokens=None,

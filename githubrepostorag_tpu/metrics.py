@@ -90,6 +90,47 @@ SPEC_ACCEPTED = Counter(
     "Speculative draft tokens the model accepted and committed",
     registry=REGISTRY,
 )
+WORKER_DEQUEUE_ERRORS = Counter(
+    "rag_worker_dequeue_errors_total",
+    "queue.dequeue() failures survived by the worker's backoff loop",
+    registry=REGISTRY,
+)
+JOBS_SHED = Counter(
+    "rag_jobs_shed_total",
+    "Jobs rejected with 429 by the bounded-queue admission check",
+    registry=REGISTRY,
+)
+JOBS_IN_FLIGHT = Gauge(
+    "rag_jobs_in_flight", "Jobs currently executing in this worker", registry=REGISTRY
+)
+EVENT_EMIT_DROPS = Counter(
+    "rag_bus_emit_drops_total",
+    "Progress events dropped after the supervised emit exhausted retries",
+    ["event"],
+    registry=REGISTRY,
+)
+BUS_RECONNECTS = Counter(
+    "rag_bus_reconnects_total",
+    "SSE subscriber re-subscribes after a bus connection loss",
+    registry=REGISTRY,
+)
+FAULTS_INJECTED = Counter(
+    "rag_faults_injected_total",
+    "Faults fired by the FAULTS injection registry",
+    ["site", "action"],
+    registry=REGISTRY,
+)
+BREAKER_TRANSITIONS = Counter(
+    "rag_breaker_transitions_total",
+    "Circuit breaker state transitions",
+    ["dep", "to_state"],
+    registry=REGISTRY,
+)
+ENGINE_DEADLINE_REAPS = Counter(
+    "rag_engine_deadline_reaps_total",
+    "Generation requests reaped at a step boundary for exceeding their deadline",
+    registry=REGISTRY,
+)
 MOE_ASSIGNMENTS = Counter(
     "rag_moe_expert_assignments_total",
     "MoE router token->expert assignments offered (MOE_DROP_STATS=1)",
@@ -104,6 +145,18 @@ MOE_DROPPED = Counter(
 
 def render() -> bytes:
     return generate_latest(REGISTRY)
+
+
+def counter_value(metric, **labels) -> float:
+    """Read a Counter/Gauge's current value through the public collect()
+    API (tests and the health report; avoids prometheus_client privates)."""
+    want = {k: str(v) for k, v in labels.items()}
+    for sample in metric.collect()[0].samples:
+        if sample.name.endswith("_created"):
+            continue
+        if all(sample.labels.get(k) == v for k, v in want.items()):
+            return sample.value
+    return 0.0
 
 
 class MeteredLLM:

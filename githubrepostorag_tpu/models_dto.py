@@ -20,6 +20,9 @@ class QueryRequest(BaseModel):
     repo_name: Optional[str] = None
     namespace: Optional[str] = None
     force_level: Optional[str] = None  # catalog|repo|module|file|chunk
+    # wall-clock budget for the whole job; clamped to JOB_TIMEOUT_SECONDS
+    # server-side and propagated API -> worker -> agent -> engine
+    deadline_ms: Optional[int] = None
 
 
 class RAGResponse(BaseModel):

@@ -1,0 +1,43 @@
+"""Resilience layer: retry/breaker/deadline policies, deterministic fault
+injection, and supervision wrappers (see policy.py / faults.py /
+supervise.py module docs)."""
+
+from githubrepostorag_tpu.resilience.policy import (
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    breaker_states,
+    current_deadline,
+    deadline_scope,
+    get_breaker,
+    reset_breakers,
+)
+from githubrepostorag_tpu.resilience.faults import (
+    FaultSpecError,
+    InjectedFault,
+    fire_async,
+    fire_sync,
+    reset_faults,
+)
+from githubrepostorag_tpu.resilience.supervise import ResilientBus
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpen",
+    "Deadline",
+    "DeadlineExceeded",
+    "FaultSpecError",
+    "InjectedFault",
+    "ResilientBus",
+    "RetryPolicy",
+    "breaker_states",
+    "current_deadline",
+    "deadline_scope",
+    "fire_async",
+    "fire_sync",
+    "get_breaker",
+    "reset_breakers",
+    "reset_faults",
+]

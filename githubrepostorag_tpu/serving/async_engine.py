@@ -43,7 +43,7 @@ class AsyncEngine:
         # instance state, so a stop()/start() relaunch doesn't re-export
         # the full cumulative totals
         self._exported = {"hit": 0, "prop": 0, "acc": 0,
-                          "packed_tok": 0, "packed_pad": 0}
+                          "packed_tok": 0, "packed_pad": 0, "reaps": 0}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -65,6 +65,7 @@ class AsyncEngine:
     def _drive(self) -> None:
         from githubrepostorag_tpu.metrics import (
             DECODE_TOKENS,
+            ENGINE_DEADLINE_REAPS,
             ENGINE_RUNNING,
             ENGINE_WAITING,
             PACKED_PREFILL_PADDING,
@@ -87,9 +88,11 @@ class AsyncEngine:
             SPEC_ACCEPTED.inc(self.engine.spec_accepted - last["acc"])
             PACKED_PREFILL_TOKENS.inc(ptok - last["packed_tok"])
             PACKED_PREFILL_PADDING.inc(ppad - last["packed_pad"])
+            reaps = self.engine.deadline_reaps
+            ENGINE_DEADLINE_REAPS.inc(reaps - last["reaps"])
             last.update(hit=hit, prop=self.engine.spec_proposed,
                         acc=self.engine.spec_accepted,
-                        packed_tok=ptok, packed_pad=ppad)
+                        packed_tok=ptok, packed_pad=ppad, reaps=reaps)
 
         while not self._stop:
             with self._lock:
@@ -120,8 +123,11 @@ class AsyncEngine:
         prompt_ids: list[int],
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> AsyncIterator[StreamEvent]:
-        """Submit a request and yield token events then the final event."""
+        """Submit a request and yield token events then the final event.
+        ``deadline_s`` (absolute time.monotonic()) lets the engine reap the
+        request at a step boundary once its caller's budget is gone."""
         await self.start()
         q: asyncio.Queue[StreamEvent] = asyncio.Queue()
 
@@ -130,7 +136,8 @@ class AsyncEngine:
 
         with self._lock:
             rid = self.engine.add_request(
-                prompt_ids, sampling, on_token=on_token, request_id=request_id
+                prompt_ids, sampling, on_token=on_token, request_id=request_id,
+                deadline_s=deadline_s,
             )
             self._queues[rid] = q
         self._wake.set()
@@ -148,8 +155,9 @@ class AsyncEngine:
         prompt_ids: list[int],
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> GenerationResult:
-        async for event in self.stream(prompt_ids, sampling, request_id):
+        async for event in self.stream(prompt_ids, sampling, request_id, deadline_s=deadline_s):
             if event.type == "final":
                 return event.result
         raise RuntimeError("stream ended without a final event")  # pragma: no cover
@@ -172,4 +180,5 @@ class AsyncEngine:
                 ),
                 "spec_proposed": self.engine.spec_proposed,
                 "spec_accepted": self.engine.spec_accepted,
+                "deadline_reaps": self.engine.deadline_reaps,
             }

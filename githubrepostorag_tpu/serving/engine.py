@@ -64,7 +64,7 @@ class GenerationResult:
     request_id: str
     prompt_tokens: list[int]
     output_tokens: list[int]
-    finish_reason: str  # "stop" | "length" | "cancelled" | "error"
+    finish_reason: str  # "stop" | "length" | "cancelled" | "deadline" | "error"
     ttft_s: float | None = None
     decode_time_s: float = 0.0
     error: str | None = None
@@ -89,6 +89,11 @@ class _Request:
     error: str | None = None
     submit_t: float = field(default_factory=time.monotonic)
     first_token_t: float | None = None
+    # absolute time.monotonic() budget; past it the request is reaped at
+    # the next step boundary (pages freed) instead of decoding on for a
+    # caller that stopped waiting
+    deadline_ts: float | None = None
+    deadline_expired: bool = False
 
 
 from githubrepostorag_tpu.utils import next_bucket as _bucket
@@ -261,6 +266,7 @@ class Engine:
         self.spec_proposed = 0  # stats: draft tokens offered / accepted
         self.spec_accepted = 0
         self.requests_admitted = 0  # cumulative add_request count
+        self.deadline_reaps = 0  # requests reaped past their deadline
 
         # host-side batch state
         self._block_tables = np.zeros((max_num_seqs, self.max_pages_per_seq), dtype=np.int32)
@@ -316,10 +322,12 @@ class Engine:
         sampling: SamplingParams | None = None,
         on_token: TokenCallback | None = None,
         request_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> str:
         rid = request_id or f"req-{next(self._ids)}"
         sampling = sampling or SamplingParams()
-        req = _Request(request_id=rid, prompt=list(prompt_ids), sampling=sampling, on_token=on_token)
+        req = _Request(request_id=rid, prompt=list(prompt_ids), sampling=sampling,
+                       on_token=on_token, deadline_ts=deadline_s)
         if len(req.prompt) + sampling.max_tokens > self.max_seq_len:
             req.sampling = sampling.clamped(self.max_seq_len - len(req.prompt))
         self._requests[rid] = req
@@ -386,6 +394,7 @@ class Engine:
             res.error = req.error
             finished.append(res)
         self._rejected.clear()
+        self._reap_expired()
         self._reap_cancelled(finished)
 
         prefilled = self._try_prefill(finished)
@@ -415,15 +424,33 @@ class Engine:
             self._drain_chain(finished)
         return finished
 
+    def _reap_expired(self) -> None:
+        """Mark past-deadline requests cancelled so the cancel/reap path
+        below returns their pages this step — a job whose caller already
+        timed out must not keep decoding to max_tokens on the device
+        (the orphaned-work half of the scheduler-stall argument)."""
+        now = time.monotonic()
+        for req in itertools.chain(self._waiting, self._row_req.values()):
+            if (
+                req.deadline_ts is not None
+                and not req.cancelled
+                and now >= req.deadline_ts
+            ):
+                req.cancelled = True
+                req.deadline_expired = True
+                self.deadline_reaps += 1
+
     def _reap_cancelled(self, finished: list[GenerationResult]) -> None:
         for req in [r for r in self._waiting if r.cancelled]:
             self._waiting.remove(req)
             req.state = "done"
-            finished.append(self._result(req, "cancelled"))
+            finished.append(self._result(
+                req, "deadline" if req.deadline_expired else "cancelled"))
         for row, req in list(self._row_req.items()):
             if req.cancelled:
                 self._release(req)
-                finished.append(self._result(req, "cancelled"))
+                finished.append(self._result(
+                    req, "deadline" if req.deadline_expired else "cancelled"))
 
     def _register_full_pages(self, req: _Request) -> None:
         """Publish every prompt page prefill has completed so far: its KV is

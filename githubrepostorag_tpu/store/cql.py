@@ -434,8 +434,17 @@ class CQLSession:
         to True; a future non-idempotent statement (counter update,
         non-keyed insert) must pass ``idempotent=False`` through
         ``execute`` and handle the reconnect error itself."""
+        from githubrepostorag_tpu.resilience.faults import InjectedFault, fire_sync
+
         with self._lock:
             try:
+                # ``cql.exchange`` injection seam — inside the try so an
+                # injected failure exercises the same reconnect/replay
+                # branches a real dead socket does.  Sits here rather than
+                # in _exchange_locked so the STARTUP/auth handshake stays
+                # fault-free (handshake failures are deliberately terminal).
+                if fire_sync("cql.exchange"):
+                    raise InjectedFault("injected drop at cql.exchange")
                 return self._exchange_locked(opcode, body)
             except OSError:
                 # dead/misaligned socket: reconnect; replay only if safe

@@ -23,7 +23,15 @@ from typing import Any
 from githubrepostorag_tpu.agent import GraphAgent, RunCancelled
 from githubrepostorag_tpu.config import get_settings
 from githubrepostorag_tpu.events.base import CancelFlags, EnqueuedJob, JobQueue, ProgressBus
-from githubrepostorag_tpu.metrics import JOB_DURATION, JOBS_TOTAL, RETRIEVAL_HITS
+from githubrepostorag_tpu.metrics import (
+    JOB_DURATION,
+    JOBS_IN_FLIGHT,
+    JOBS_TOTAL,
+    RETRIEVAL_HITS,
+    WORKER_DEQUEUE_ERRORS,
+)
+from githubrepostorag_tpu.resilience.policy import Deadline, DeadlineExceeded, RetryPolicy
+from githubrepostorag_tpu.resilience.supervise import ResilientBus
 from githubrepostorag_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -41,7 +49,10 @@ class RagWorker:
     ) -> None:
         s = get_settings()
         self.agent = agent
-        self.bus = bus
+        # every emit goes through the supervised bus: retried with backoff
+        # behind the shared "bus" breaker, terminal events with a deeper
+        # budget, drops counted (resilience/supervise.py)
+        self.bus = bus if isinstance(bus, ResilientBus) else ResilientBus(bus)
         self.flags = flags
         self.queue = queue
         self.max_jobs = max_jobs or s.worker_max_jobs
@@ -54,8 +65,23 @@ class RagWorker:
 
     async def run_forever(self) -> None:
         logger.info("worker: consuming jobs (max_jobs=%d)", self.max_jobs)
+        policy = RetryPolicy.from_settings()
+        failures = 0
         while not self._stopping:
-            job = await self.queue.dequeue()
+            try:
+                job = await self.queue.dequeue()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a flaky queue must not kill the loop
+                WORKER_DEQUEUE_ERRORS.inc()
+                delay = policy.delay_for(failures)
+                failures += 1
+                logger.exception(
+                    "dequeue failed (attempt %d); retrying in %.3fs", failures, delay
+                )
+                await asyncio.sleep(delay)
+                continue
+            failures = 0
             await self._sem.acquire()
             task = asyncio.create_task(self._run_with_limit(job))
             self._tasks.add(task)
@@ -65,19 +91,26 @@ class RagWorker:
         self._stopping = True
 
     async def _run_with_limit(self, job: EnqueuedJob) -> None:
+        JOBS_IN_FLIGHT.inc()
         try:
             if job.function != "run_rag_job":
                 logger.warning("unknown job function %r", job.function)
                 return
-            await asyncio.wait_for(self.run_rag_job(job), timeout=self.job_timeout)
-        except asyncio.TimeoutError:
+            wire = (job.kwargs or {}).get("deadline")
+            deadline = Deadline.from_wire(wire) if wire else Deadline(self.job_timeout)
+            # the outer wait_for is a backstop; the deadline itself travels
+            # into the agent and engine, so the budget caps the wall clock
+            timeout = max(0.05, min(float(self.job_timeout), deadline.remaining()))
+            await asyncio.wait_for(self.run_rag_job(job, deadline), timeout=timeout)
+        except (asyncio.TimeoutError, DeadlineExceeded):
             JOBS_TOTAL.labels(status="timeout").inc()
-            await self._terminal(job.job_id, error=f"job timed out after {self.job_timeout}s")
+            await self._terminal(job.job_id, error=f"job exceeded its deadline ({self.job_timeout}s cap)")
         except Exception as exc:  # noqa: BLE001
             logger.exception("job %s crashed", job.job_id)
             JOBS_TOTAL.labels(status="error").inc()
             await self._terminal(job.job_id, error=str(exc))
         finally:
+            JOBS_IN_FLIGHT.dec()
             self._sem.release()
 
     async def _terminal(self, job_id: str, error: str) -> None:
@@ -92,8 +125,10 @@ class RagWorker:
 
     # ------------------------------------------------------------ the job
 
-    async def run_rag_job(self, job: EnqueuedJob) -> dict[str, Any]:
+    async def run_rag_job(self, job: EnqueuedJob, deadline: Deadline | None = None) -> dict[str, Any]:
         job_id = job.job_id
+        if deadline is not None and deadline.expired:
+            raise DeadlineExceeded(f"job {job_id} deadline expired before it started")
         req: dict[str, Any] = job.args[1] if len(job.args) > 1 else (job.args[0] if job.args else {})
         if not isinstance(req, dict):
             req = {}
@@ -122,9 +157,12 @@ class RagWorker:
 
         async def poll_cancel() -> None:
             while not cancelled.is_set():
-                if await self.flags.is_cancelled(job_id):
-                    cancelled.set()
-                    return
+                try:
+                    if await self.flags.is_cancelled(job_id):
+                        cancelled.set()
+                        return
+                except Exception:  # noqa: BLE001 - flag-store outage must not stop polling
+                    logger.exception("cancel poll failed for %s", job_id)
                 await asyncio.sleep(0.5)
 
         poller = asyncio.create_task(poll_cancel())
@@ -148,7 +186,7 @@ class RagWorker:
                 lambda: self.agent.run(
                     query, namespace=namespace, progress_cb=progress_cb,
                     force_level=force_level, should_stop=cancelled.is_set,
-                    token_cb=token_cb, top_k=top_k,
+                    token_cb=token_cb, top_k=top_k, deadline=deadline,
                 ),
             )
         except RunCancelled:

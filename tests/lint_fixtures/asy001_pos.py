@@ -10,3 +10,15 @@ async def poll_backend(url):
     resp = requests.get(url, timeout=5)  # sync HTTP on the loop
     subprocess.run(["true"], check=True)  # sync child process on the loop
     return resp
+
+
+async def retry_with_backoff(fn, attempts=3):
+    """The resilience-layer bug class: a retry helper whose backoff sleep
+    blocks the event loop, stalling every other in-flight job between
+    attempts (must be asyncio.sleep)."""
+    for n in range(attempts):
+        try:
+            return await fn()
+        except ConnectionError:
+            time.sleep(0.05 * (2 ** n))  # parks the whole loop per retry
+    raise ConnectionError("out of attempts")

@@ -115,6 +115,8 @@ class MiniRedis:
                 elif cmd == "LPUSH":
                     self.lists[args[1]].appendleft(args[2])
                     writer.write(self._int(len(self.lists[args[1]])))
+                elif cmd == "LLEN":
+                    writer.write(self._int(len(self.lists.get(args[1], ()))))
                 elif cmd == "BRPOP":
                     key, timeout = args[1], float(args[2])
                     deadline = time.monotonic() + (timeout or 1e9)

@@ -1,0 +1,721 @@
+"""Chaos suite for the resilience layer: the fault-injection registry, the
+retry/breaker/deadline primitives, and full enqueue -> agent -> SSE jobs
+driven through MemoryEvents and miniredis under injected faults.  The
+invariants under test are the tentpole's acceptance bar: every job reaches a
+terminal event, nothing hangs past its deadline, and a deadline-reaped
+engine request returns every KV page it held."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from githubrepostorag_tpu.agent import GraphAgent
+from githubrepostorag_tpu.config import reload_settings
+from githubrepostorag_tpu.embedding import HashingTextEncoder
+from githubrepostorag_tpu.events import MemoryBus, MemoryCancelFlags, MemoryJobQueue
+from githubrepostorag_tpu.events.base import ProgressBus, channel_for
+from githubrepostorag_tpu.llm import FakeLLM
+from githubrepostorag_tpu.metrics import (
+    BUS_RECONNECTS,
+    EVENT_EMIT_DROPS,
+    FAULTS_INJECTED,
+    JOBS_SHED,
+    WORKER_DEQUEUE_ERRORS,
+    counter_value,
+)
+from githubrepostorag_tpu.resilience.faults import (
+    FaultSpecError,
+    InjectedFault,
+    _parse_entry,
+    active,
+    fire_sync,
+    get_registry,
+    reset_faults,
+)
+from githubrepostorag_tpu.resilience.policy import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    breaker_states,
+    current_deadline,
+    deadline_scope,
+    get_breaker,
+)
+from githubrepostorag_tpu.resilience.supervise import ResilientBus
+from githubrepostorag_tpu.retrieval import RetrieverFactory
+from githubrepostorag_tpu.store import Doc, MemoryVectorStore
+from githubrepostorag_tpu.worker import RagWorker
+
+from tests.test_api_worker import AGENT_SCRIPT, _collect_events, _with_service
+
+
+def _enable(monkeypatch, spec: str, seed: int = 0, **env: str) -> None:
+    """Point FAULTS at ``spec`` and rebuild the registry from env."""
+    monkeypatch.setenv("FAULTS", spec)
+    monkeypatch.setenv("FAULTS_SEED", str(seed))
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    reload_settings()
+    reset_faults()
+
+
+# ------------------------------------------------------------ fault registry
+
+
+def test_fault_spec_parses_sites_actions_and_params(monkeypatch):
+    _enable(monkeypatch, "redis.send:drop@3;cql.exchange:error@0.5;llm.complete:delay=2")
+    reg = get_registry()
+    assert set(reg.by_site) == {"redis.send", "cql.exchange", "llm.complete"}
+    assert reg.by_site["redis.send"][0].action == "drop"
+    assert reg.by_site["redis.send"][0].every == 3
+    assert reg.by_site["cql.exchange"][0].probability == 0.5
+    assert reg.by_site["llm.complete"][0].delay_s == 2.0
+    assert active()
+
+
+def test_drop_every_nth_is_deterministic(monkeypatch):
+    _enable(monkeypatch, "x.site:drop@3")
+    fired = [fire_sync("x.site") for _ in range(9)]
+    assert fired == [False, False, True, False, False, True, False, False, True]
+    assert counter_value(FAULTS_INJECTED, site="x.site", action="drop") >= 3
+
+
+def test_probability_faults_are_seeded(monkeypatch):
+    def pattern() -> list[bool]:
+        out = []
+        for _ in range(40):
+            try:
+                fire_sync("y.site")
+                out.append(False)
+            except InjectedFault:
+                out.append(True)
+        return out
+
+    _enable(monkeypatch, "y.site:error@0.5", seed=123)
+    first = pattern()
+    reset_faults()  # re-parse: same seed must reproduce the same draws
+    assert pattern() == first
+    assert any(first) and not all(first)  # p=0.5 over 40 calls
+
+
+def test_malformed_specs_raise_at_parse():
+    for bad in ("nosite", "x:frobnicate", "x:delay", "x:drop@0", "x:drop@1.5",
+                "x:drop=3", ":drop", "x:"):
+        with pytest.raises(FaultSpecError):
+            _parse_entry(bad, seed=0)
+
+
+def test_unset_faults_is_inert():
+    assert not active()
+    assert fire_sync("redis.send") is False
+    assert get_registry().by_site == {}
+
+
+def test_delay_fault_sleeps(monkeypatch):
+    _enable(monkeypatch, "z.site:delay=0.05")
+    t0 = time.monotonic()
+    assert fire_sync("z.site") is False  # delay proceeds after sleeping
+    assert time.monotonic() - t0 >= 0.04
+
+
+# -------------------------------------------------------------- retry policy
+
+
+def test_retry_delays_are_bounded_full_jitter():
+    policy = RetryPolicy(max_attempts=5, base=0.1, cap=1.0, seed=7)
+    for attempt in range(6):
+        d = min(1.0, 0.1 * 2 ** attempt)
+        delay = policy.delay_for(attempt)
+        assert d / 2 <= delay <= d
+    # seeded stream reproduces
+    a = list(RetryPolicy(max_attempts=4, base=0.1, seed=1).delays())
+    b = list(RetryPolicy(max_attempts=4, base=0.1, seed=1).delays())
+    assert a == b and len(a) == 3
+
+
+async def test_retry_call_retries_connection_errors_then_succeeds():
+    calls = []
+
+    async def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("blip")
+        return 7
+
+    policy = RetryPolicy(max_attempts=4, base=0.001, seed=0)
+    assert await policy.call(flaky) == 7
+    assert len(calls) == 3
+
+
+async def test_retry_call_exhausts_and_propagates():
+    async def dead():
+        raise ConnectionError("hard down")
+
+    policy = RetryPolicy(max_attempts=3, base=0.001, seed=0)
+    with pytest.raises(ConnectionError, match="hard down"):
+        await policy.call(dead)
+
+
+async def test_retry_call_does_not_retry_non_connection_errors():
+    calls = []
+
+    async def broken():
+        calls.append(1)
+        raise ValueError("logic bug, not an outage")
+
+    with pytest.raises(ValueError):
+        await RetryPolicy(max_attempts=4, base=0.001).call(broken)
+    assert len(calls) == 1
+
+
+# ------------------------------------------------------------ circuit breaker
+
+
+def test_breaker_opens_half_opens_and_closes():
+    b = CircuitBreaker("dep", failure_threshold=3, reset_seconds=0.1)
+    assert b.allow() and b.state == "closed"
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()  # refused while open
+    time.sleep(0.12)
+    assert b.allow()  # reset window elapsed: the single half-open probe
+    assert b.state == "half_open"
+    assert not b.allow()  # second concurrent probe refused
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    snap = b.snapshot()
+    assert snap["transitions"] == {"open": 1, "half_open": 1, "closed": 1}
+
+
+def test_breaker_probe_failure_reopens():
+    b = CircuitBreaker("dep2", failure_threshold=1, reset_seconds=0.05)
+    b.record_failure()
+    assert b.state == "open"
+    time.sleep(0.06)
+    assert b.allow()
+    b.record_failure()  # probe failed: straight back to open
+    assert b.state == "open"
+    assert b.snapshot()["transitions"]["open"] == 2
+
+
+def test_breaker_registry_reports_states():
+    b = get_breaker("llm.http", failure_threshold=1)
+    assert get_breaker("llm.http") is b
+    b.record_failure()
+    states = breaker_states()
+    assert states["llm.http"]["state"] == "open"
+
+
+# ------------------------------------------------------------------ deadline
+
+
+def test_deadline_budget_and_expiry():
+    d = Deadline(0.05)
+    assert not d.expired and 0 < d.remaining() <= 0.05
+    time.sleep(0.06)
+    assert d.expired and d.remaining() == 0.0
+
+
+def test_deadline_wire_roundtrip_preserves_budget():
+    d = Deadline(5.0)
+    d2 = Deadline.from_wire(d.to_wire())
+    assert abs(d2.remaining() - d.remaining()) < 0.1
+
+
+def test_deadline_scope_is_thread_local():
+    assert current_deadline() is None
+    d = Deadline(1.0)
+    with deadline_scope(d):
+        assert current_deadline() is d
+        with deadline_scope(None):
+            assert current_deadline() is None
+        assert current_deadline() is d
+    assert current_deadline() is None
+
+
+# --------------------------------------------------------------- supervised bus
+
+
+class _FlakyInner(ProgressBus):
+    """Fails the first ``fail_n`` emits with ConnectionError, then records."""
+
+    def __init__(self, fail_n: int) -> None:
+        self.fail_n = fail_n
+        self.calls = 0
+        self.delivered: list[tuple[str, str]] = []
+
+    async def emit(self, job_id, event, data):
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise ConnectionError("bus blip")
+        self.delivered.append((job_id, event))
+
+    async def stream(self, job_id):  # pragma: no cover - unused
+        yield ""
+
+    async def close(self):
+        pass
+
+
+async def test_resilient_bus_absorbs_transient_failures(monkeypatch):
+    monkeypatch.setenv("RETRY_BASE_SECONDS", "0.005")
+    reload_settings()
+    inner = _FlakyInner(fail_n=2)
+    before = counter_value(EVENT_EMIT_DROPS, event="turn")
+    await ResilientBus(inner).emit("j", "turn", {})
+    assert inner.delivered == [("j", "turn")]
+    assert counter_value(EVENT_EMIT_DROPS, event="turn") == before
+
+
+async def test_resilient_bus_terminal_events_get_deeper_budget(monkeypatch):
+    monkeypatch.setenv("RETRY_BASE_SECONDS", "0.005")
+    reload_settings()
+    # 5 failures: past the default 4-attempt progress budget, inside the
+    # >= 6-attempt terminal budget
+    dropped = _FlakyInner(fail_n=5)
+    before = counter_value(EVENT_EMIT_DROPS, event="turn")
+    await ResilientBus(dropped).emit("j", "turn", {})
+    assert dropped.delivered == []  # progress chatter: dropped, counted
+    assert counter_value(EVENT_EMIT_DROPS, event="turn") == before + 1
+
+    delivered = _FlakyInner(fail_n=5)
+    await ResilientBus(delivered).emit("j", "final", {"answer": "x"})
+    assert delivered.delivered == [("j", "final")]  # terminal: survives
+
+
+async def test_resilient_bus_open_breaker_sheds_without_calling_inner():
+    get_breaker("bus", failure_threshold=1).record_failure()  # force open
+    inner = _FlakyInner(fail_n=0)
+    before = counter_value(EVENT_EMIT_DROPS, event="iteration")
+    await ResilientBus(inner).emit("j", "iteration", {})
+    assert inner.calls == 0  # fast-path drop: dependency never touched
+    assert counter_value(EVENT_EMIT_DROPS, event="iteration") == before + 1
+
+
+# ------------------------------------------------- worker dequeue supervision
+
+
+class _FlakyQueue(MemoryJobQueue):
+    def __init__(self, fail_n: int) -> None:
+        super().__init__()
+        self.failures_left = fail_n
+
+    async def dequeue(self):
+        if self.failures_left > 0:
+            self.failures_left -= 1
+            raise ConnectionError("injected dequeue failure")
+        return await super().dequeue()
+
+
+def _agent() -> GraphAgent:
+    store, enc = MemoryVectorStore(), HashingTextEncoder()
+    text = "async def create_job(request): enqueue and return job id"
+    store.upsert("embeddings", [Doc(
+        "c1", text,
+        {"namespace": "default", "scope": "chunk", "repo": "api",
+         "module": "app", "file_path": "app/jobs.py"},
+        enc.encode([text])[0],
+    )])
+    return GraphAgent(FakeLLM(script=AGENT_SCRIPT), RetrieverFactory(store, enc),
+                      namespace="default")
+
+
+async def test_worker_survives_flaky_dequeue(monkeypatch):
+    """Satellite 1 regression: a queue.dequeue() raise used to kill
+    run_forever silently — jobs then queued forever with live SSE clients
+    attached.  Now: counted, backed off, survived."""
+    monkeypatch.setenv("RETRY_BASE_SECONDS", "0.005")
+    reload_settings()
+    queue = _FlakyQueue(fail_n=3)
+    worker = RagWorker(_agent(), MemoryBus(), MemoryCancelFlags(), queue,
+                       max_jobs=2, job_timeout=10)
+    before = counter_value(WORKER_DEQUEUE_ERRORS)
+    task = asyncio.create_task(worker.run_forever())
+    try:
+        await queue.enqueue_job("run_rag_job", "fj", {"query": "q"}, _job_id="fj")
+        result = None
+        for _ in range(400):
+            result = await queue.get_result("fj")
+            if result is not None:
+                break
+            await asyncio.sleep(0.025)
+        assert result is not None and result.get("answer")
+        assert counter_value(WORKER_DEQUEUE_ERRORS) - before == 3
+    finally:
+        worker.stop()
+        task.cancel()
+
+
+# ----------------------------------------------------- end-to-end: memory hub
+
+
+async def test_memory_stack_chaos_every_job_reaches_final(monkeypatch):
+    """Full enqueue -> agent -> SSE with every 3rd bus emit failing and the
+    LLM lagging: the supervised emit path must absorb the faults so every
+    job still delivers its complete, correct event sequence."""
+    _enable(monkeypatch, "bus.emit:drop@3;llm.complete:delay=0.01",
+            RETRY_BASE_SECONDS="0.005")
+
+    async def body(session, base, api, worker):
+        ids = []
+        for i in range(3):
+            resp = await session.post(f"{base}/rag/jobs",
+                                      json={"query": f"how are jobs created? v{i}"})
+            assert resp.status == 200
+            ids.append((await resp.json())["job_id"])
+        results = await asyncio.wait_for(
+            asyncio.gather(*(_collect_events(session, base, j) for j in ids)),
+            timeout=30,
+        )
+        for events in results:
+            # progress chatter may be legitimately dropped (counted) under
+            # sustained faults; the guarantee is the terminal event and a
+            # correct answer, not a complete transcript
+            assert events[-1]["event"] == "final"
+            assert events[-1]["data"]["answer"]
+        stats = get_registry().stats()
+        assert sum(e["fired"] for e in stats["bus.emit"]) >= 1
+        assert sum(e["fired"] for e in stats["llm.complete"]) >= 1
+
+    await _with_service(body)
+
+
+async def test_deadline_ms_expires_job_to_terminal_error(monkeypatch):
+    """deadline_ms travels API -> queue -> worker -> agent: a budget the slow
+    LLM cannot meet must surface as a terminal error+final pair well before
+    the 30s job timeout, never a hang."""
+
+    class SlowLLM(FakeLLM):
+        def complete(self, prompt, **kw):
+            time.sleep(0.25)
+            return super().complete(prompt, **kw)
+
+    slow = SlowLLM(script={
+        r"Pick the retrieval scope": '{"scope": "chunk", "filters": {}}',
+        r"Assess whether the retrieved": '{"coverage": 0.2, "needs_more": true}',
+        r"Rephrase": "retry query",
+        r"alternative search": '["alt"]',
+        r"senior engineer": "too late to matter",
+    })
+
+    async def body(session, base, api, worker):
+        t0 = time.monotonic()
+        resp = await session.post(f"{base}/rag/jobs",
+                                  json={"query": "slow question", "deadline_ms": 400})
+        assert resp.status == 200
+        job_id = (await resp.json())["job_id"]
+        events = await asyncio.wait_for(
+            _collect_events(session, base, job_id), timeout=15)
+        elapsed = time.monotonic() - t0
+        # the error frame is terminal for SSE clients (the stream closes on
+        # it); the paired empty final still reaches pollers via the bus
+        assert events[-1]["event"] == "error"
+        assert "deadline" in events[-1]["data"]["error"]
+        assert elapsed < 10  # budget + slack, nowhere near job_timeout
+
+    await _with_service(slow_llm=slow, fn=body)
+
+
+async def test_invalid_deadline_ms_rejected():
+    async def body(session, base, api, worker):
+        resp = await session.post(f"{base}/rag/jobs",
+                                  json={"query": "q", "deadline_ms": -5})
+        assert resp.status == 400
+        assert "deadline_ms" in (await resp.json())["error"]
+
+    await _with_service(body)
+
+
+async def test_full_queue_sheds_with_429_and_retry_after(monkeypatch):
+    monkeypatch.setenv("JOB_QUEUE_MAX_DEPTH", "0")
+    reload_settings()
+
+    async def body(session, base, api, worker):
+        before = counter_value(JOBS_SHED)
+        resp = await session.post(f"{base}/rag/jobs", json={"query": "q"})
+        assert resp.status == 429
+        assert "Retry-After" in resp.headers
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert "full" in (await resp.json())["error"]
+        assert counter_value(JOBS_SHED) - before == 1
+
+    await _with_service(body)
+
+
+# ---------------------------------------------------------------- SSE hygiene
+
+
+class _StalledBus(ProgressBus):
+    """Says nothing for a while, then one final frame — an agent thinking."""
+
+    async def emit(self, job_id, event, data):  # pragma: no cover - unused
+        pass
+
+    async def stream(self, job_id):
+        await asyncio.sleep(0.25)
+        yield 'data: {"event": "final", "data": {"answer": "late"}}\n\n'
+
+    async def close(self):
+        pass
+
+
+class _DyingBus(ProgressBus):
+    """One frame, then a non-connection failure inside the stream."""
+
+    async def emit(self, job_id, event, data):  # pragma: no cover - unused
+        pass
+
+    async def stream(self, job_id):
+        yield 'data: {"event": "started", "data": {}}\n\n'
+        raise RuntimeError("decode exploded")
+
+    async def close(self):
+        pass
+
+
+async def _raw_sse(bus, heartbeat_env: str) -> bytes:
+    import aiohttp
+
+    from githubrepostorag_tpu.api.app import RagApi
+
+    api = RagApi(bus, MemoryCancelFlags(), MemoryJobQueue())
+    port = await api.start(host="127.0.0.1", port=0)
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{port}/rag/jobs/j1/events",
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as resp:
+                return await resp.content.read()
+    finally:
+        await api.stop()
+
+
+async def test_sse_heartbeats_flow_while_bus_is_silent(monkeypatch):
+    monkeypatch.setenv("SSE_HEARTBEAT_SECONDS", "0.05")
+    reload_settings()
+    raw = await _raw_sse(_StalledBus(), "0.05")
+    assert raw.count(b": heartbeat\n\n") >= 2  # 0.25s gap / 0.05s beat
+    assert b'"event": "final"' in raw
+
+
+async def test_sse_bus_failure_sends_error_frame_and_closes(monkeypatch):
+    monkeypatch.setenv("SSE_HEARTBEAT_SECONDS", "5")
+    reload_settings()
+    raw = await _raw_sse(_DyingBus(), "5")
+    assert b'"event": "started"' in raw
+    assert b"event stream failed" in raw  # the error frame, then EOF
+    assert raw.rstrip().endswith(b"}")
+
+
+# -------------------------------------------------------------------- health
+
+
+async def test_health_503_while_a_breaker_is_open():
+    async def body(session, base, api, worker):
+        healthy = await session.get(f"{base}/health")
+        assert healthy.status == 200
+        payload = await healthy.json()
+        res = payload["components"]["resilience"]
+        assert res["status"] == "UP"
+        assert "queue_depth" in res["details"]
+        assert isinstance(res["details"]["jobs_in_flight"], int)
+
+        b = get_breaker("llm.http", failure_threshold=2, reset_seconds=60)
+        b.record_failure()
+        b.record_failure()
+        resp = await session.get(f"{base}/health")
+        assert resp.status == 503
+        payload = await resp.json()
+        assert payload["status"] == "DOWN"
+        res = payload["components"]["resilience"]
+        assert res["status"] == "DOWN"
+        assert res["details"]["breakers"]["llm.http"]["state"] == "open"
+
+    await _with_service(body)
+
+
+# -------------------------------------------------- end-to-end: redis (mini)
+
+
+async def test_redis_stream_reconnects_after_connection_loss(monkeypatch):
+    """Reconnect-with-backoff supervision: killing the server side of the
+    SUBSCRIBE connection must re-subscribe (counted) and resume delivery."""
+    from githubrepostorag_tpu.events.redis import RedisBus
+    from tests.miniredis import MiniRedis
+
+    monkeypatch.setenv("RETRY_BASE_SECONDS", "0.01")
+    reload_settings()
+    server = MiniRedis()
+    port = await server.start()
+    bus = RedisBus(f"redis://127.0.0.1:{port}/0", ping_interval=0.1)
+    channel = channel_for("jr")
+    frames: list[str] = []
+    done = asyncio.Event()
+
+    async def subscriber():
+        async for f in bus.stream("jr"):
+            if f.startswith("data:"):
+                frames.append(f)
+                if len(frames) >= 2:
+                    done.set()
+                    return
+
+    task = asyncio.create_task(subscriber())
+    try:
+        for _ in range(300):
+            if server.subscribers.get(channel):
+                break
+            await asyncio.sleep(0.01)
+        await bus.emit("jr", "turn", {"n": 1})
+        for _ in range(300):
+            if frames:
+                break
+            await asyncio.sleep(0.01)
+        assert frames, "first event never arrived"
+
+        before = counter_value(BUS_RECONNECTS)
+        for w in list(server.subscribers.get(channel, [])):
+            w.close()  # server-side kill: LB reap / redis restart
+        server.subscribers[channel].clear()
+        for _ in range(500):  # wait for the re-subscribe to land
+            if server.subscribers.get(channel):
+                break
+            await asyncio.sleep(0.01)
+        assert server.subscribers.get(channel), "client never re-subscribed"
+        assert counter_value(BUS_RECONNECTS) - before >= 1
+
+        await bus.emit("jr", "final", {"answer": "hi"})
+        await asyncio.wait_for(done.wait(), timeout=5)
+        assert '"final"' in frames[-1]
+    finally:
+        task.cancel()
+        await bus.close()
+        await server.stop()
+
+
+async def test_redis_stack_chaos_job_reaches_terminal(monkeypatch):
+    """The miniredis leg of the tentpole chaos bar: with every 5th RESP send
+    dropped (dequeue, publish, flag polls, result writes all share the seam)
+    a job must still reach a terminal event — degraded is fine, hung is not."""
+    from githubrepostorag_tpu.events.redis import RedisBus, RedisCancelFlags, RedisJobQueue
+    from tests.miniredis import MiniRedis
+
+    _enable(monkeypatch, "redis.send:drop@5", seed=3, RETRY_BASE_SECONDS="0.01")
+    server = MiniRedis()
+    port = await server.start()
+    url = f"redis://127.0.0.1:{port}/0"
+    bus = RedisBus(url, ping_interval=0.1)
+    worker = RagWorker(_agent(), bus, RedisCancelFlags(url), RedisJobQueue(url),
+                       max_jobs=2, job_timeout=10)
+    queue = RedisJobQueue(url)  # test's own handle, separate connections
+    channel = channel_for("cj")
+    events: list[dict] = []
+    terminal = asyncio.Event()
+
+    async def subscriber():
+        async for f in bus.stream("cj"):
+            if f.startswith("data:"):
+                events.append(json.loads(f[len("data:"):].strip()))
+                if events[-1]["event"] == "final":
+                    terminal.set()
+                    return
+
+    sub = asyncio.create_task(subscriber())
+    wtask = asyncio.create_task(worker.run_forever())
+    try:
+        for _ in range(500):
+            if server.subscribers.get(channel):
+                break
+            await asyncio.sleep(0.01)
+        deadline_wire = Deadline(8.0).to_wire()
+        for _ in range(8):  # the LPUSH itself may ride into a drop
+            try:
+                await queue.enqueue_job("run_rag_job", "cj",
+                                        {"query": "how are jobs created?"},
+                                        _job_id="cj", deadline=deadline_wire)
+                break
+            except (ConnectionError, OSError):
+                await asyncio.sleep(0.02)
+        await asyncio.wait_for(terminal.wait(), timeout=20)
+        assert events[-1]["event"] == "final"
+        stats = get_registry().stats()
+        assert sum(e["fired"] for e in stats["redis.send"]) >= 1
+    finally:
+        worker.stop()
+        sub.cancel()
+        wtask.cancel()
+        await bus.close()
+        await server.stop()
+
+
+# ------------------------------------------------- engine deadline reaping
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    from githubrepostorag_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg.to_dict())
+    params = params_from_state_dict(model.state_dict(), cfg)
+    return params, cfg
+
+
+def test_engine_deadline_reap_recycles_every_page(tiny_model):
+    """The page-accounting half of the tentpole acceptance bar: a request
+    whose deadline lapses mid-generation is reaped at a step boundary with
+    finish_reason 'deadline' and ALL of its KV pages back in the pool."""
+    import jax.numpy as jnp
+
+    from githubrepostorag_tpu.serving import Engine, SamplingParams
+
+    params, cfg = tiny_model
+    eng = Engine(params, cfg, max_num_seqs=4, num_pages=64, page_size=8,
+                 max_seq_len=128, prefill_chunk=32, kv_dtype=jnp.float32)
+    assert eng._allocator.free_count == eng._allocator.num_pages
+    sp = SamplingParams(temperature=0.0, max_tokens=100, stop_token_ids=())
+    rid = eng.add_request([1, 2, 3, 4], sp, deadline_s=time.monotonic() + 0.2)
+    done = []
+    while eng.has_work():
+        done.extend(eng.step())
+        time.sleep(0.01)  # 100 decode steps cannot beat a 0.2s budget
+    assert [r.request_id for r in done] == [rid]
+    assert done[0].finish_reason == "deadline"
+    assert len(done[0].output_tokens) < 100  # genuinely cut short
+    assert eng._allocator.free_count == eng._allocator.num_pages  # pages recycled
+    assert eng.deadline_reaps == 1
+
+    # a generous deadline must never be reaped: same engine, normal finish
+    res = None
+    rid2 = eng.add_request([5, 6, 7], SamplingParams(
+        temperature=0.0, max_tokens=5, stop_token_ids=()),
+        deadline_s=time.monotonic() + 300.0)
+    while eng.has_work():
+        for r in eng.step():
+            res = r
+    assert res is not None and res.request_id == rid2
+    assert res.finish_reason == "length" and len(res.output_tokens) == 5
+    assert eng._allocator.free_count == eng._allocator.num_pages
+    assert eng.deadline_reaps == 1
+
+
+def test_agent_raises_deadline_exceeded_at_stage_boundary():
+    agent = _agent()
+    with pytest.raises(DeadlineExceeded):
+        agent.run("how are jobs created?", deadline=Deadline(0.0))

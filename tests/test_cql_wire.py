@@ -145,6 +145,77 @@ def test_reconnect_after_connection_drop(store):
     assert store.health()["status"] == "UP"
 
 
+class _KillableCassandra(MiniCassandra):
+    """MiniCassandra that dies mid-exchange: when a QUERY containing
+    ``kill_on`` arrives it records the query, then closes the connection
+    WITHOUT replying — the client is left waiting on a half-done exchange,
+    exactly what a node crash between request and response looks like."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.kill_on: str | None = None
+
+    def _run(self, cql: str):
+        if self.kill_on and self.kill_on in cql:
+            self.kill_on = None  # one-shot: the replayed request succeeds
+            raise ConnectionError("server killed mid-exchange")
+        return super()._run(cql)
+
+
+@pytest.fixture()
+def killable():
+    srv = _KillableCassandra()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_idempotent_request_is_replayed_after_mid_exchange_death(killable):
+    """The server reads the full request then dies before replying — an
+    ambiguous failure.  Idempotent statements (everything this store
+    issues) reconnect and replay transparently: the server must see the
+    statement TWICE and the caller sees one clean result."""
+    sess = CQLSession("127.0.0.1", killable.port)
+    killable.kill_on = "release_version"
+    rs = sess.execute("SELECT release_version FROM system.local")
+    assert rs.one().release_version == "5.0-mini"
+    seen = [q for q in killable.queries if "release_version" in q]
+    assert len(seen) == 2  # original attempt + the replay
+
+
+def test_non_idempotent_request_is_not_replayed(killable):
+    """idempotent=False gates the replay: after the ambiguous failure the
+    error propagates (the statement may have applied server-side), the
+    server saw it exactly once, and the reconnected session stays usable."""
+    sess = CQLSession("127.0.0.1", killable.port)
+    killable.kill_on = "USE ks_counter"
+    with pytest.raises((CQLError, OSError)):
+        sess.execute("USE ks_counter", idempotent=False)
+    seen = [q for q in killable.queries if "ks_counter" in q]
+    assert len(seen) == 1  # never replayed
+    # the session already reconnected: next statement works first try
+    rs = sess.execute("SELECT release_version FROM system.local")
+    assert rs.one().release_version == "5.0-mini"
+
+
+def test_injected_cql_fault_exercises_the_replay_path(killable, monkeypatch):
+    """The cql.exchange fault seam rides the same reconnect/replay branches
+    as a real dead socket: with every exchange erroring once per 2 calls,
+    idempotent traffic still completes."""
+    from githubrepostorag_tpu.config import reload_settings
+    from githubrepostorag_tpu.resilience.faults import get_registry, reset_faults
+
+    sess = CQLSession("127.0.0.1", killable.port)  # handshake pre-faults
+    monkeypatch.setenv("FAULTS", "cql.exchange:error@2")
+    reload_settings()
+    reset_faults()
+    for _ in range(4):  # calls 2, 4, ... fault then replay
+        rs = sess.execute("SELECT release_version FROM system.local")
+        assert rs.one().release_version == "5.0-mini"
+    stats = get_registry().stats()
+    assert sum(e["fired"] for e in stats["cql.exchange"]) >= 2
+
+
 def test_unicode_text_roundtrip(store):
     store.upsert("chunks", [Doc("u", "héllo 世界 🚀", {"λ": "µ"}, _vec(6))])
     got = store.get("chunks", "u")

@@ -66,6 +66,15 @@ def test_negative_fixtures_are_fully_clean():
         assert findings == [], f"{neg.name}: {[f.rule for f in findings]}"
 
 
+def test_asy001_fires_on_blocking_sleep_in_async_retry_helper():
+    # the resilience-layer hazard: jittered-backoff helpers must use
+    # asyncio.sleep — a time.sleep between retries parks every coroutine
+    findings = analyze_file(FIXTURES / "asy001_pos.py")
+    hits = [f for f in findings if f.rule == "ASY001" and f.line > 13]
+    assert hits, "ASY001 missed the blocking backoff inside retry_with_backoff"
+    assert all(not f.suppressed for f in hits)
+
+
 def test_tpu003_fires_on_unbucketed_search_fixture():
     # the hazard retrieval/device_index.py's bucket contract exists to
     # prevent: corpus/query counts flowing straight into jitted shapes
