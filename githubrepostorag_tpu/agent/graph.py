@@ -32,6 +32,7 @@ from githubrepostorag_tpu.agent import prompts
 from githubrepostorag_tpu.agent.state import AgentState, ProgressCallback
 from githubrepostorag_tpu.config import get_settings
 from githubrepostorag_tpu.llm import LLM
+from githubrepostorag_tpu.obs.trace import TraceContext, span, trace_scope
 from githubrepostorag_tpu.resilience.policy import Deadline, DeadlineExceeded, deadline_scope
 from githubrepostorag_tpu.retrieval import RetrievedDoc, RetrieverFactory
 from githubrepostorag_tpu.retrieval.retrievers import SCOPE_LADDER
@@ -386,6 +387,7 @@ class GraphAgent:
         token_cb: Callable[[str], None] | None = None,
         top_k: int | None = None,
         deadline: Deadline | None = None,
+        trace: "TraceContext | None" = None,
     ) -> AgentResult:
         state = AgentState(query=question, original_query=question,
                            progress_cb=progress_cb, top_k=top_k)
@@ -400,23 +402,33 @@ class GraphAgent:
 
         # the deadline rides a thread-local scope for the duration of the
         # run so every llm.complete inside any stage sees the SAME budget
-        # without widening the LLM protocol signature
-        with deadline_scope(deadline):
-            check_cancel()
-            # force_level honored (the reference read it but ignored it —
-            # worker.py:101-107, SURVEY.md Appendix A) and skips the plan LLM call
-            self.plan_scope(state, force_level=force_level)
+        # without widening the LLM protocol signature; the trace context
+        # rides a contextvar scope the same way (run executes on an
+        # executor thread, which inherits neither — both cross explicitly)
+        with deadline_scope(deadline), trace_scope(trace):
+            with span("agent.run") as run_sp:
+                check_cancel()
+                # force_level honored (the reference read it but ignored it —
+                # worker.py:101-107, SURVEY.md Appendix A) and skips the plan LLM call
+                with span("agent.plan"):
+                    self.plan_scope(state, force_level=force_level)
 
-            while True:
+                while True:
+                    check_cancel()
+                    with span("agent.retrieve", scope=state.scope or ""):
+                        self.retrieve(state)
+                    check_cancel()
+                    with span("agent.judge"):
+                        self.judge(state)
+                    check_cancel()  # rewrite pays an LLM call; don't start it cancelled
+                    with span("agent.rewrite"):
+                        decision = self.rewrite_or_end(state)
+                    if decision == "synthesize":
+                        break
                 check_cancel()
-                self.retrieve(state)
-                check_cancel()
-                self.judge(state)
-                check_cancel()  # rewrite pays an LLM call; don't start it cancelled
-                if self.rewrite_or_end(state) == "synthesize":
-                    break
-            check_cancel()
-            self.synthesize(state, token_cb=token_cb)
+                with span("agent.synthesize"):
+                    self.synthesize(state, token_cb=token_cb)
+                run_sp.set_attr("sources", len(state.sources))
         return AgentResult(answer=state.answer or "", sources=state.sources, debug=state.debug)
 
     # ------------------------------------------------------------ helpers

@@ -25,6 +25,7 @@ from githubrepostorag_tpu.config import get_settings
 from githubrepostorag_tpu.events.base import CancelFlags, JobQueue, ProgressBus
 from githubrepostorag_tpu.metrics import HTTP_LATENCY, HTTP_REQUESTS, JOBS_SHED, render
 from githubrepostorag_tpu.models_dto import QueryRequest
+from githubrepostorag_tpu.obs import current_context, get_recorder, root_span
 from githubrepostorag_tpu.resilience.policy import Deadline
 from githubrepostorag_tpu.utils.logging import get_logger
 
@@ -60,6 +61,30 @@ async def _metrics_middleware(request: web.Request, handler):
 
 
 @web.middleware
+async def _trace_middleware(request: web.Request, handler):
+    """Root span per /rag request (the job-facing surface; scrape and
+    debug endpoints would just fill the recorder ring with noise).  An
+    incoming ``traceparent`` header is continued, so an upstream gateway's
+    trace connects straight through to engine decode spans."""
+    if not request.path.startswith("/rag"):
+        return await handler(request)
+    resource = request.match_info.route.resource if request.match_info.route else None
+    route = resource.canonical if resource else "unmatched"
+    with root_span(f"http {request.method} {route}",
+                   wire=request.headers.get("traceparent")) as sp:
+        try:
+            response = await handler(request)
+        except web.HTTPException as exc:
+            if exc.status >= 500:
+                sp.set_status(f"error: http {exc.status}")
+            raise
+        if response.status >= 500:
+            sp.set_status(f"error: http {response.status}")
+        sp.set_attr("status", response.status)
+        return response
+
+
+@web.middleware
 async def _cors_middleware(request: web.Request, handler):
     if request.method == "OPTIONS":
         response = web.Response(status=204)
@@ -79,11 +104,15 @@ class RagApi:
         self._runner: web.AppRunner | None = None
 
     def make_app(self) -> web.Application:
-        app = web.Application(middlewares=[_cors_middleware, _metrics_middleware])
+        app = web.Application(
+            middlewares=[_cors_middleware, _metrics_middleware, _trace_middleware]
+        )
         app.router.add_post("/rag/jobs", self.create_job)
         app.router.add_get("/rag/jobs/{job_id}/events", self.job_events)
         app.router.add_post("/rag/jobs/{job_id}/cancel", self.cancel_job)
         app.router.add_get("/rag/jobs/{job_id}/result", self.job_result)
+        app.router.add_get("/debug/traces", self.debug_traces)
+        app.router.add_get("/debug/traces/{trace_id}", self.debug_trace)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/", self.index_redirect)
@@ -143,14 +172,21 @@ class RagApi:
         job_id = uuid.uuid4().hex
         cap_ms = s.job_timeout_seconds * 1000
         budget_ms = min(req.deadline_ms or cap_ms, cap_ms)
+        # the trace context (opened by _trace_middleware) crosses the queue
+        # on the envelope next to the deadline; the worker continues it
+        ctx = current_context()
         await self.queue.enqueue_job(
             "run_rag_job",
             job_id,
             req.model_dump(),
             _job_id=job_id,
             deadline=Deadline(budget_ms / 1000.0).to_wire(),
+            trace=ctx.to_wire() if ctx is not None and ctx.sampled else None,
         )
-        return web.json_response({"job_id": job_id})
+        body = {"job_id": job_id}
+        if ctx is not None and ctx.sampled:
+            body["trace_id"] = ctx.trace_id
+        return web.json_response(body)
 
     async def job_events(self, request: web.Request) -> web.StreamResponse:
         job_id = request.match_info["job_id"]
@@ -225,6 +261,16 @@ class RagApi:
         if result is None:
             return web.json_response({"error": "no result (pending, expired, or unknown)"}, status=404)
         return web.json_response(result)
+
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        return web.json_response(get_recorder().summaries_payload())
+
+    async def debug_trace(self, request: web.Request) -> web.Response:
+        payload = get_recorder().trace_payload(request.match_info["trace_id"])
+        if payload is None:
+            return web.json_response({"error": "unknown trace (evicted or never recorded)"},
+                                     status=404)
+        return web.json_response(payload)
 
     async def health(self, request: web.Request) -> web.Response:
         import asyncio

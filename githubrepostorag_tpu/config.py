@@ -153,6 +153,17 @@ class Settings:
     # would OOM the chip with no hint the env var was ignored.
     quantize_weights: int = field(default_factory=lambda: _parse_quant_bits())
 
+    # --- Observability ---
+    # trace sampling rate [0, 1]; 0 disables root-span creation entirely
+    # (the span() fast path becomes a single contextvar read — bench.py
+    # asserts the overhead budget under this setting)
+    trace_sample: float = field(default_factory=lambda: _env_float("TRACE_SAMPLE", 1.0))
+    # flight-recorder ring-buffer bounds: O(traces * spans) memory, period
+    trace_max_traces: int = field(default_factory=lambda: _env_int("TRACE_MAX_TRACES", 256))
+    trace_max_spans: int = field(default_factory=lambda: _env_int("TRACE_MAX_SPANS", 128))
+    # json (trace-stamped structured lines) | plain (human format)
+    log_format: str = field(default_factory=lambda: os.getenv("LOG_FORMAT", "json"))
+
     # --- Worker ---
     default_namespace: str = field(default_factory=lambda: os.getenv("DEFAULT_NAMESPACE", "default"))
     metrics_port: int = field(default_factory=lambda: _env_int("METRICS_PORT", 9000))

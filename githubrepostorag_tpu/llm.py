@@ -234,27 +234,46 @@ class InProcessLLM:
         return time.monotonic() + remaining, min(timeout, remaining + 5.0)
 
     def complete(self, prompt, *, system=None, max_tokens=None, temperature=None) -> str:
+        from githubrepostorag_tpu.obs.engine_profile import record_engine_spans
+        from githubrepostorag_tpu.obs.trace import NOOP_SPAN
+        from githubrepostorag_tpu.obs.trace import span as trace_span
+
         gate = _llm_preamble()
         if gate is not None:
             return gate
         loop = self._ensure_loop()
         deadline_s, timeout = self._deadline_budget()
-        fut = asyncio.run_coroutine_threadsafe(
-            self.engine.generate(self._prompt_ids(prompt, system),
-                                 self._sampling(max_tokens, temperature),
-                                 deadline_s=deadline_s),
-            loop,
-        )
-        try:
-            result = fut.result(timeout=timeout)
-        except Exception as exc:  # noqa: BLE001 - errors travel as text
-            logger.error("InProcessLLM error: %s", exc)
-            return f"Error: {exc}"
-        if result.finish_reason == "error":
-            return f"Error: {result.error}"
-        if result.finish_reason == "deadline":
-            return "Error: deadline exceeded (engine reaped the request)"
-        return _postprocess(prompt, self.tokenizer.decode(result.output_tokens))
+        with trace_span("llm.generate") as sp:
+            # registered spans receive xla_compile events if this request's
+            # steps trigger a fresh compilation (obs/engine_profile.py)
+            profiler = getattr(self.engine, "profiler", None)
+            live = sp is not NOOP_SPAN and profiler is not None
+            if live:
+                profiler.register(sp)
+            try:
+                fut = asyncio.run_coroutine_threadsafe(
+                    self.engine.generate(self._prompt_ids(prompt, system),
+                                         self._sampling(max_tokens, temperature),
+                                         deadline_s=deadline_s),
+                    loop,
+                )
+                result = fut.result(timeout=timeout)
+            except Exception as exc:  # noqa: BLE001 - errors travel as text
+                logger.error("InProcessLLM error: %s", exc)
+                sp.set_status(f"error: {type(exc).__name__}")
+                return f"Error: {exc}"
+            finally:
+                if live:
+                    profiler.unregister(sp)
+            record_engine_spans(result, parent=sp.context)
+            sp.set_attr("finish_reason", result.finish_reason)
+            if result.finish_reason == "error":
+                sp.set_status("error: engine")
+                return f"Error: {result.error}"
+            if result.finish_reason == "deadline":
+                sp.set_status("error: deadline")
+                return "Error: deadline exceeded (engine reaped the request)"
+            return _postprocess(prompt, self.tokenizer.decode(result.output_tokens))
 
     def complete_batch(self, prompts: Sequence[str], *, system=None,
                        max_tokens=None, temperature=None) -> list[str]:
@@ -301,6 +320,9 @@ class InProcessLLM:
                         temperature=None, on_text=None) -> Iterator[str]:
         from githubrepostorag_tpu.serving.tokenizer import StreamingDetokenizer
 
+        from githubrepostorag_tpu.obs.engine_profile import record_engine_spans
+        from githubrepostorag_tpu.obs.trace import Span, current_context
+
         gate = _llm_preamble()
         if gate is not None:
             if on_text:
@@ -309,6 +331,14 @@ class InProcessLLM:
             return
         loop = self._ensure_loop()
         deadline_s, _ = self._deadline_budget()
+        # manual span: the generator body runs on the consumer's schedule
+        # and the engine result surfaces on the pump (llm-loop) thread, so
+        # the trace context is captured here and threaded in explicitly
+        ctx = current_context()
+        sp = Span("llm.generate", ctx) if ctx is not None and ctx.sampled else None
+        profiler = getattr(self.engine, "profiler", None)
+        if sp is not None and profiler is not None:
+            profiler.register(sp)
 
         async def pump():
             detok = StreamingDetokenizer(self.tokenizer)
@@ -323,19 +353,28 @@ class InProcessLLM:
                     tail = detok.flush()
                     if tail:
                         sync_q.put(tail)
+                    if sp is not None and event.result is not None:
+                        record_engine_spans(event.result, parent=sp.context)
+                        sp.set_attr("finish_reason", event.result.finish_reason)
             sync_q.put(None)
 
         import queue as _queue
 
         sync_q: "_queue.Queue[str | None]" = _queue.Queue()
         asyncio.run_coroutine_threadsafe(pump(), loop)
-        while True:
-            delta = sync_q.get()
-            if delta is None:
-                return
-            if on_text:
-                on_text(delta)
-            yield delta
+        try:
+            while True:
+                delta = sync_q.get()
+                if delta is None:
+                    return
+                if on_text:
+                    on_text(delta)
+                yield delta
+        finally:
+            if sp is not None:
+                if profiler is not None:
+                    profiler.unregister(sp)
+                sp.finish()
 
 
 class HTTPLLM:

@@ -131,6 +131,24 @@ ENGINE_DEADLINE_REAPS = Counter(
     "Generation requests reaped at a step boundary for exceeding their deadline",
     registry=REGISTRY,
 )
+XLA_COMPILES = Counter(
+    "rag_xla_compiles_total",
+    "Fresh XLA compilations observed during live engine stepping "
+    "(warmup should make this zero; see obs/engine_profile.py)",
+    registry=REGISTRY,
+)
+TPOT = Histogram(
+    "rag_engine_tpot_seconds",
+    "Time per output token after the first (decode seconds / decode tokens)",
+    registry=REGISTRY,
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
+SCHED_STALL = Gauge(
+    "rag_engine_sched_stall_seconds",
+    "Gap between consecutive engine steps while work exists "
+    "(scheduler stall; 0 when idle)",
+    registry=REGISTRY,
+)
 MOE_ASSIGNMENTS = Counter(
     "rag_moe_expert_assignments_total",
     "MoE router token->expert assignments offered (MOE_DROP_STATS=1)",
@@ -160,37 +178,83 @@ def counter_value(metric, **labels) -> float:
 
 
 class MeteredLLM:
-    """LLM wrapper recording call counts + latency (worker.py:73-88)."""
+    """LLM wrapper recording call counts + latency (worker.py:73-88), and a
+    ``llm.complete``/``llm.stream`` span per call when a trace is active."""
 
     def __init__(self, inner) -> None:
         self._inner = inner
 
     def complete(self, prompt, **kw) -> str:
-        start = time.monotonic()
-        text = self._inner.complete(prompt, **kw)
-        LLM_LATENCY.observe(time.monotonic() - start)
-        LLM_CALLS.labels(status="error" if text.startswith("Error:") else "ok").inc()
+        from githubrepostorag_tpu.obs.trace import span as trace_span
+
+        with trace_span("llm.complete", prompt_chars=len(prompt)) as sp:
+            start = time.monotonic()
+            text = self._inner.complete(prompt, **kw)
+            LLM_LATENCY.observe(time.monotonic() - start)
+            status = "error" if text.startswith("Error:") else "ok"
+            LLM_CALLS.labels(status=status).inc()
+            if status != "ok":
+                sp.set_status("error: llm")
+            sp.set_attr("completion_chars", len(text))
         return text
 
     def complete_batch(self, prompts, **kw) -> list[str]:
+        from githubrepostorag_tpu.obs.trace import span as trace_span
+
         batch = getattr(self._inner, "complete_batch", None)
-        start = time.monotonic()
-        if callable(batch):
-            out = batch(prompts, **kw)
-        else:
-            out = [self._inner.complete(p, **kw) for p in prompts]
-        LLM_LATENCY.observe(time.monotonic() - start)
-        for text in out:
-            LLM_CALLS.labels(status="error" if text.startswith("Error:") else "ok").inc()
+        with trace_span("llm.complete_batch", batch_size=len(prompts)) as sp:
+            start = time.monotonic()
+            if callable(batch):
+                out = batch(prompts, **kw)
+            else:
+                out = [self._inner.complete(p, **kw) for p in prompts]
+            LLM_LATENCY.observe(time.monotonic() - start)
+            errors = 0
+            for text in out:
+                bad = text.startswith("Error:")
+                errors += bad
+                LLM_CALLS.labels(status="error" if bad else "ok").inc()
+            if errors:
+                sp.set_status("error: llm")
+                sp.set_attr("errors", errors)
         return out
 
     def stream_complete(self, prompt, **kw) -> Iterator[str]:
+        from githubrepostorag_tpu.obs.trace import current_context
+        from githubrepostorag_tpu.obs.trace import Span as TraceSpan
+
+        # a generator's body runs lazily on the consumer's schedule, so the
+        # span is managed by hand (opened under the caller's context at
+        # first pull) instead of via the contextmanager
+        ctx = current_context()
+        sp = TraceSpan("llm.stream", ctx) if ctx is not None and ctx.sampled else None
         start = time.monotonic()
         first = True
-        for delta in self._inner.stream_complete(prompt, **kw):
-            if first:
-                TTFT.observe(time.monotonic() - start)
-                first = False
-            yield delta
-        LLM_LATENCY.observe(time.monotonic() - start)
-        LLM_CALLS.labels(status="ok").inc()
+        status = "ok"
+        deltas = 0
+        try:
+            for delta in self._inner.stream_complete(prompt, **kw):
+                if first:
+                    TTFT.observe(time.monotonic() - start)
+                    first = False
+                if delta.startswith("Error:"):
+                    # backends yield errors as text, never raise — an
+                    # "Error:" delta IS the failure signal
+                    status = "error"
+                deltas += 1
+                DECODE_TOKENS.inc()
+                yield delta
+        except GeneratorExit:
+            status = "cancelled"  # consumer closed the stream early
+            raise
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            LLM_LATENCY.observe(time.monotonic() - start)
+            LLM_CALLS.labels(status=status).inc()
+            if sp is not None:
+                sp.set_attr("deltas", deltas)
+                if status != "ok":
+                    sp.set_status(f"error: stream {status}")
+                sp.finish()

@@ -1,0 +1,39 @@
+"""Observability layer: distributed tracing, the per-job flight recorder,
+engine step profiling, and trace-stamped JSON logging.
+
+One trace per job, causally linked across every hop the job takes:
+API middleware opens the root span, the queue envelope carries the context
+(``TraceContext.to_wire`` rides ``kwargs["trace"]`` exactly like
+``Deadline`` rides ``kwargs["deadline"]``), the worker continues it, the
+agent wraps its stages, and the serving engine attributes queue-wait /
+prefill / decode.  Completed spans land in a bounded in-process flight
+recorder exposed at ``GET /debug/traces``.
+"""
+
+from githubrepostorag_tpu.obs.trace import (
+    NOOP_SPAN,
+    Span,
+    TraceContext,
+    current_context,
+    current_span,
+    record_span,
+    root_span,
+    span,
+    trace_scope,
+)
+from githubrepostorag_tpu.obs.recorder import FlightRecorder, get_recorder, reset_recorder
+
+__all__ = [
+    "FlightRecorder",
+    "NOOP_SPAN",
+    "Span",
+    "TraceContext",
+    "current_context",
+    "current_span",
+    "get_recorder",
+    "record_span",
+    "reset_recorder",
+    "root_span",
+    "span",
+    "trace_scope",
+]

@@ -1,0 +1,186 @@
+"""Serving-engine step instrumentation.
+
+Three concerns, all driven from the engine driver thread
+(``AsyncEngine._drive``) so the event loop never pays for them:
+
+* **Per-request phase attribution** — the engine stamps monotonic
+  timestamps as a request moves waiting -> prefilling -> first token ->
+  done (``GenerationResult.timings``); ``record_engine_spans`` turns
+  those into retroactive ``engine.queue_wait`` / ``engine.prefill`` /
+  ``engine.decode`` spans under the request's trace, so a flight-recorder
+  dump shows exactly where a slow TTFT went.
+
+* **Scheduler-stall gauge + TPOT histogram** — the gap between
+  consecutive steps while work exists is scheduler stall (vLLM's
+  throughput killer per PAPERS.md, invisible in aggregate latency
+  histograms); TPOT is decode seconds per generated token after the
+  first.
+
+* **XLA compile watchdog** — sums ``_cache_size()`` over every jitted
+  callable in the serving/model modules each step.  A positive delta
+  while serving means live traffic just paid an XLA compile the warmup
+  ladder failed to predict: ``rag_xla_compiles_total`` increments and
+  every registered in-flight span gets an ``xla_compile`` event, so the
+  one request that stalled 30 s on a TPU compile tunnel says so in its
+  own timeline.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+from typing import TYPE_CHECKING, Any, Iterable
+
+from githubrepostorag_tpu.obs.trace import TraceContext, record_span
+from githubrepostorag_tpu.utils.logging import get_logger
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from githubrepostorag_tpu.obs.trace import Span
+
+logger = get_logger(__name__)
+
+# every module that defines top-level jit objects the engine dispatches;
+# importing lazily and tolerantly — a module missing its accelerator dep
+# simply contributes no jits
+DEFAULT_JIT_MODULES = (
+    "githubrepostorag_tpu.serving.engine",
+    "githubrepostorag_tpu.serving.decode_burst",
+    "githubrepostorag_tpu.serving.spec_burst",
+    "githubrepostorag_tpu.serving.long_prefill",
+    "githubrepostorag_tpu.models.qwen2",
+    "githubrepostorag_tpu.ops.sampling",
+    "githubrepostorag_tpu.ops.packed_prefill",
+)
+
+
+def discover_jits(module_names: Iterable[str] = DEFAULT_JIT_MODULES) -> list[tuple[str, Any]]:
+    """Find every module-level object exposing jit's ``_cache_size`` in the
+    serving/model modules — the complete set of programs live traffic can
+    trigger a compile through."""
+    jits: list[tuple[str, Any]] = []
+    for name in module_names:
+        try:
+            mod = importlib.import_module(name)
+        except Exception:  # noqa: BLE001 - optional accelerator deps
+            continue
+        for attr, obj in vars(mod).items():
+            if callable(getattr(obj, "_cache_size", None)):
+                jits.append((f"{name}.{attr}", obj))
+    return jits
+
+
+class CompileWatchdog:
+    """Tracks the total jit program count and reports fresh compiles as
+    deltas between samples."""
+
+    def __init__(self, jits: list[tuple[str, Any]] | None = None) -> None:
+        self._jits = discover_jits() if jits is None else list(jits)
+        self._last = self.cache_size()
+
+    def cache_size(self) -> int:
+        total = 0
+        for _, obj in self._jits:
+            try:
+                total += int(obj._cache_size())
+            except Exception:  # noqa: BLE001 - a torn-down jit reads as 0
+                pass
+        return total
+
+    def resync(self) -> None:
+        """Rebaseline — called at serve start so warmup's own compiles
+        (expected, pre-traffic) never count as live-traffic compiles."""
+        self._last = self.cache_size()
+
+    def sample(self) -> int:
+        """New programs compiled since the previous sample (>= 0)."""
+        size = self.cache_size()
+        delta = size - self._last
+        self._last = size
+        return max(0, delta)
+
+
+class EngineStepProfiler:
+    """Per-step hook owned by ``AsyncEngine``.  ``on_step`` runs once per
+    engine step on the driver thread; in-flight request spans register so
+    compile events land on the request that was stalled by them."""
+
+    def __init__(self, watchdog: CompileWatchdog | None = None) -> None:
+        self.watchdog = watchdog or CompileWatchdog()
+        self._lock = threading.Lock()
+        self._live: dict[int, "Span"] = {}
+        self._last_step_end: float | None = None
+
+    # ----------------------------------------------------- live requests --
+
+    def register(self, span: "Span") -> None:
+        with self._lock:
+            self._live[id(span)] = span
+
+    def unregister(self, span: "Span") -> None:
+        with self._lock:
+            self._live.pop(id(span), None)
+
+    def mark_warm(self) -> None:
+        """Declare warmup finished: compiles observed after this are
+        live-traffic compiles."""
+        self.watchdog.resync()
+        self._last_step_end = None
+
+    # ------------------------------------------------------------- steps --
+
+    def on_step(self, step_start: float, step_end: float) -> int:
+        """Record stall + compile telemetry for one completed engine step.
+        Returns the number of fresh compiles observed (for tests)."""
+        from githubrepostorag_tpu.metrics import SCHED_STALL, XLA_COMPILES
+
+        if self._last_step_end is not None:
+            SCHED_STALL.set(max(0.0, step_start - self._last_step_end))
+        self._last_step_end = step_end
+
+        delta = self.watchdog.sample()
+        if delta > 0:
+            XLA_COMPILES.inc(delta)
+            with self._lock:
+                live = list(self._live.values())
+            for sp in live:
+                sp.add_event("xla_compile", new_programs=delta,
+                             step_s=round(step_end - step_start, 6))
+            logger.warning(
+                "xla compile during live traffic: %d new program(s) in a %.3fs step "
+                "(warmup should have predicted this shape)",
+                delta, step_end - step_start,
+            )
+        return delta
+
+    def idle(self) -> None:
+        """The driver found no work — the next gap is idleness, not stall."""
+        self._last_step_end = None
+        from githubrepostorag_tpu.metrics import SCHED_STALL
+
+        SCHED_STALL.set(0.0)
+
+
+def record_engine_spans(result: Any, parent: TraceContext | None) -> None:
+    """Turn a ``GenerationResult``'s monotonic phase stamps into
+    queue-wait / prefill / decode spans under ``parent``.  Tolerates
+    partial timings (errored or reaped requests may never prefill)."""
+    timings = getattr(result, "timings", None)
+    if not timings or parent is None or not parent.sampled:
+        return
+    submit = timings.get("submit_t")
+    pstart = timings.get("prefill_start_t")
+    ftok = timings.get("first_token_t")
+    done = timings.get("done_t", time.monotonic())
+    attrs = {"request_id": getattr(result, "request_id", "")}
+    if submit is not None and pstart is not None:
+        record_span("engine.queue_wait", submit, pstart, parent=parent, attrs=attrs)
+    if pstart is not None and ftok is not None:
+        record_span("engine.prefill", pstart, ftok, parent=parent, attrs={
+            **attrs, "prompt_tokens": len(getattr(result, "prompt_tokens", ()) or ()),
+        })
+    if ftok is not None and done > ftok:
+        record_span("engine.decode", ftok, done, parent=parent, attrs={
+            **attrs, "output_tokens": len(getattr(result, "output_tokens", ()) or ()),
+            "finish_reason": getattr(result, "finish_reason", ""),
+        })

@@ -295,7 +295,7 @@ class Deadline:
     @classmethod
     def from_wire(cls, wire: dict[str, float]) -> "Deadline":
         budget_s = float(wire.get("budget_ms", 0)) / 1000.0
-        transit = max(0.0, time.time() - float(wire.get("t0", time.time())))
+        transit = max(0.0, time.time() - float(wire.get("t0", time.time())))  # tpulint: disable=OBS001 -- cross-process transit needs the wall clock; monotonic bases differ per host and the max(0,...) clamp absorbs skew
         return cls(budget_s - transit)
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid

@@ -34,6 +34,7 @@ from typing import Mapping, Sequence
 import numpy as np
 
 from githubrepostorag_tpu.metrics import RETRIEVAL_SECONDS, RETRIEVAL_WAVE_SIZE
+from githubrepostorag_tpu.obs.trace import current_context, record_span
 from githubrepostorag_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -41,7 +42,7 @@ logger = get_logger(__name__)
 
 class _Request:
     __slots__ = ("table", "text", "kind", "k", "filter", "done", "qvec",
-                 "hits", "error", "t_submit")
+                 "hits", "error", "t_submit", "t_dispatch", "wave_size", "ctx")
 
     def __init__(self, table: str, text: str, kind: str, k: int,
                  filter: Mapping[str, str] | None) -> None:
@@ -55,6 +56,12 @@ class _Request:
         self.hits = None
         self.error: BaseException | None = None
         self.t_submit = time.monotonic()
+        # stamped by the drain thread when the wave ships; the caller's
+        # trace context is captured at submit because the drain thread has
+        # no scope of its own (it serves every caller's wave at once)
+        self.t_dispatch: float | None = None
+        self.wave_size = 0
+        self.ctx = current_context()
 
 
 class RetrievalCoalescer:
@@ -96,7 +103,16 @@ class RetrievalCoalescer:
         out = []
         for r in reqs:
             r.done.wait()
-            RETRIEVAL_SECONDS.observe(time.monotonic() - r.t_submit)
+            t_done = time.monotonic()
+            RETRIEVAL_SECONDS.observe(t_done - r.t_submit)
+            # wave-formation wait vs dispatch, attributed to the caller's
+            # trace (no-ops when untraced)
+            if r.ctx is not None and r.t_dispatch is not None:
+                record_span("retrieval.wave_wait", r.t_submit, r.t_dispatch,
+                            parent=r.ctx, attrs={"wave_size": r.wave_size})
+                record_span("retrieval.dispatch", r.t_dispatch, t_done,
+                            parent=r.ctx,
+                            attrs={"wave_size": r.wave_size, "table": r.table})
             if r.error is not None:
                 raise r.error
             out.append((r.qvec, r.hits))
@@ -142,6 +158,10 @@ class RetrievalCoalescer:
                         self._wake.clear()
                 wave.extend(extra)
             RETRIEVAL_WAVE_SIZE.observe(len(wave))
+            t_dispatch = time.monotonic()
+            for r in wave:
+                r.t_dispatch = t_dispatch
+                r.wave_size = len(wave)
             try:
                 self._run_wave(wave)
             except BaseException as exc:  # noqa: BLE001 - fan the error out

@@ -13,9 +13,11 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
+from githubrepostorag_tpu.obs.engine_profile import EngineStepProfiler
 from githubrepostorag_tpu.serving.engine import Engine, GenerationResult
 from githubrepostorag_tpu.serving.sampling_params import SamplingParams
 from githubrepostorag_tpu.utils.logging import get_logger
@@ -44,6 +46,9 @@ class AsyncEngine:
         # the full cumulative totals
         self._exported = {"hit": 0, "prop": 0, "acc": 0,
                           "packed_tok": 0, "packed_pad": 0, "reaps": 0}
+        # step profiler: scheduler-stall gauge + XLA compile watchdog,
+        # sampled once per step on the driver thread (obs/engine_profile)
+        self.profiler = EngineStepProfiler()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -51,6 +56,10 @@ class AsyncEngine:
         if self._thread is not None:
             return
         self._stop = False  # allow stop() -> start() relaunch
+        # rebaseline the compile watchdog: programs compiled before serve
+        # start (warmup, imports) are expected — only compiles during live
+        # stepping should count
+        self.profiler.mark_warm()
         self._loop = asyncio.get_running_loop()
         self._thread = threading.Thread(target=self._drive, name="engine-driver", daemon=True)
         self._thread.start()
@@ -94,17 +103,27 @@ class AsyncEngine:
                         acc=self.engine.spec_accepted,
                         packed_tok=ptok, packed_pad=ppad, reaps=reaps)
 
+        from githubrepostorag_tpu.metrics import TPOT
+
         while not self._stop:
+            step_start = time.monotonic()
             with self._lock:
                 has_work = self.engine.has_work()
                 finished = self.engine.step() if has_work else []
                 ENGINE_RUNNING.set(self.engine.num_running)
                 ENGINE_WAITING.set(self.engine.num_waiting)
                 export_counters()
+            if has_work:
+                self.profiler.on_step(step_start, time.monotonic())
+            else:
+                self.profiler.idle()
             for res in finished:
                 DECODE_TOKENS.inc(len(res.output_tokens))
                 if res.ttft_s is not None:
                     TTFT.observe(res.ttft_s)
+                decoded = len(res.output_tokens) - 1  # first token is prefill's
+                if decoded > 0 and res.decode_time_s > 0:
+                    TPOT.observe(res.decode_time_s / decoded)
                 self._emit(res.request_id, StreamEvent(type="final", result=res))
             if not has_work:
                 self._wake.wait(timeout=0.02)

@@ -68,6 +68,10 @@ class GenerationResult:
     ttft_s: float | None = None
     decode_time_s: float = 0.0
     error: str | None = None
+    # monotonic phase stamps (submit_t / prefill_start_t / first_token_t /
+    # done_t) for trace attribution — obs/engine_profile.record_engine_spans
+    # turns these into queue-wait / prefill / decode spans
+    timings: dict | None = None
 
 
 @dataclass
@@ -88,6 +92,7 @@ class _Request:
     cancelled: bool = False
     error: str | None = None
     submit_t: float = field(default_factory=time.monotonic)
+    prefill_start_t: float | None = None  # admission: waiting -> prefilling
     first_token_t: float | None = None
     # absolute time.monotonic() budget; past it the request is reaped at
     # the next step boundary (pages freed) instead of decoding on for a
@@ -571,6 +576,7 @@ class Engine:
             self._waiting.pop(0)
             row = self._free_rows.pop()
             req.row, req.pages, req.state = row, pages, "prefilling"
+            req.prefill_start_t = time.monotonic()
             # cache hit: prefill resumes after the shared pages' tokens
             req.cached_tokens = len(shared) * self.page_size
             req.prefill_pos = req.cached_tokens
@@ -1326,13 +1332,20 @@ class Engine:
         # long-running server doesn't accumulate every prompt ever served
         self._requests.pop(req.request_id, None)
         ttft = (req.first_token_t - req.submit_t) if req.first_token_t else None
+        done_t = time.monotonic()
         return GenerationResult(
             request_id=req.request_id,
             prompt_tokens=req.prompt,
             output_tokens=req.output,
             finish_reason=reason,
             ttft_s=ttft,
-            decode_time_s=(time.monotonic() - req.first_token_t) if req.first_token_t else 0.0,
+            decode_time_s=(done_t - req.first_token_t) if req.first_token_t else 0.0,
+            timings={
+                "submit_t": req.submit_t,
+                "prefill_start_t": req.prefill_start_t,
+                "first_token_t": req.first_token_t,
+                "done_t": done_t,
+            },
         )
 
     # --------------------------------------------------------- convenience --

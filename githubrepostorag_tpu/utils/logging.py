@@ -1,4 +1,7 @@
-"""Stdlib logging setup honoring LOG_LEVEL (rag_shared/config.py:9)."""
+"""Stdlib logging setup honoring LOG_LEVEL (rag_shared/config.py:9) and
+LOG_FORMAT: ``json`` (default) routes through the trace-stamped JSON
+formatter (obs/logging.py) so every line carries trace_id/span_id;
+``plain`` keeps the human-format lines."""
 
 from __future__ import annotations
 
@@ -11,9 +14,15 @@ _configured = False
 def get_logger(name: str) -> logging.Logger:
     global _configured
     if not _configured:
-        logging.basicConfig(
-            level=os.getenv("LOG_LEVEL", "INFO").upper(),
-            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-        )
+        level = os.getenv("LOG_LEVEL", "INFO").upper()
+        if os.getenv("LOG_FORMAT", "json").strip().lower() == "json":
+            from githubrepostorag_tpu.obs.logging import configure_json_logging
+
+            configure_json_logging(level)
+        else:
+            logging.basicConfig(
+                level=level,
+                format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+            )
         _configured = True
     return logging.getLogger(name)

@@ -30,6 +30,7 @@ from githubrepostorag_tpu.metrics import (
     RETRIEVAL_HITS,
     WORKER_DEQUEUE_ERRORS,
 )
+from githubrepostorag_tpu.obs import current_context, get_recorder, root_span
 from githubrepostorag_tpu.resilience.policy import Deadline, DeadlineExceeded, RetryPolicy
 from githubrepostorag_tpu.resilience.supervise import ResilientBus
 from githubrepostorag_tpu.utils.logging import get_logger
@@ -96,28 +97,52 @@ class RagWorker:
             if job.function != "run_rag_job":
                 logger.warning("unknown job function %r", job.function)
                 return
-            wire = (job.kwargs or {}).get("deadline")
+            kwargs = job.kwargs or {}
+            wire = kwargs.get("deadline")
             deadline = Deadline.from_wire(wire) if wire else Deadline(self.job_timeout)
             # the outer wait_for is a backstop; the deadline itself travels
             # into the agent and engine, so the budget caps the wall clock
             timeout = max(0.05, min(float(self.job_timeout), deadline.remaining()))
-            await asyncio.wait_for(self.run_rag_job(job, deadline), timeout=timeout)
-        except (asyncio.TimeoutError, DeadlineExceeded):
-            JOBS_TOTAL.labels(status="timeout").inc()
-            await self._terminal(job.job_id, error=f"job exceeded its deadline ({self.job_timeout}s cap)")
-        except Exception as exc:  # noqa: BLE001
-            logger.exception("job %s crashed", job.job_id)
-            JOBS_TOTAL.labels(status="error").inc()
-            await self._terminal(job.job_id, error=str(exc))
+            # continue the trace the API opened (kwargs["trace"] rides the
+            # envelope exactly like the deadline); old envelopes without it
+            # start a fresh worker-rooted trace
+            with root_span("worker.job", wire=kwargs.get("trace"),
+                           job_id=job.job_id) as sp:
+                try:
+                    await asyncio.wait_for(self.run_rag_job(job, deadline), timeout=timeout)
+                except (asyncio.TimeoutError, DeadlineExceeded):
+                    sp.set_status("error: deadline")
+                    JOBS_TOTAL.labels(status="timeout").inc()
+                    await self._terminal(
+                        job.job_id,
+                        error=f"job exceeded its deadline ({self.job_timeout}s cap)",
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    logger.exception("job %s crashed", job.job_id)
+                    sp.set_status(f"error: {type(exc).__name__}")
+                    JOBS_TOTAL.labels(status="error").inc()
+                    await self._terminal(job.job_id, error=str(exc))
         finally:
             JOBS_IN_FLIGHT.dec()
             self._sem.release()
+
+    def _trace_summary(self) -> dict[str, Any]:
+        """Compact phase-timing summary for the terminal SSE event: the
+        active trace's id plus per-phase seconds from the flight recorder,
+        so a client sees where its job's time went without a second call.
+        Empty when the job is untraced."""
+        ctx = current_context()
+        if ctx is None or not ctx.sampled:
+            return {}
+        return {"trace_id": ctx.trace_id,
+                "phases": get_recorder().phase_summary(ctx.trace_id)}
 
     async def _terminal(self, job_id: str, error: str) -> None:
         """Emit the error+empty-final pair AND store a terminal result so
         polling clients can distinguish failed from pending."""
         await self._safe_emit(job_id, "error", {"error": error})
-        await self._safe_emit(job_id, "final", {"answer": "", "sources": []})
+        await self._safe_emit(job_id, "final",
+                              {"answer": "", "sources": [], **self._trace_summary()})
         try:
             await self.queue.set_result(job_id, {"answer": "", "sources": [], "error": error})
         except Exception:  # noqa: BLE001
@@ -180,6 +205,9 @@ class RagWorker:
                 self._safe_emit(job_id, "token", {"text": delta}), loop
             )
 
+        # run_in_executor does NOT propagate contextvars — hand the trace
+        # context to the agent explicitly, like the deadline
+        trace_ctx = current_context()
         try:
             result = await loop.run_in_executor(
                 None,
@@ -187,6 +215,7 @@ class RagWorker:
                     query, namespace=namespace, progress_cb=progress_cb,
                     force_level=force_level, should_stop=cancelled.is_set,
                     token_cb=token_cb, top_k=top_k, deadline=deadline,
+                    trace=trace_ctx,
                 ),
             )
         except RunCancelled:
@@ -210,7 +239,11 @@ class RagWorker:
                 "final_ctx_blocks": debug.get("final_ctx_blocks", 0),
             },
         )
-        await self.bus.emit(job_id, "final", {"answer": result.answer, "sources": result.sources})
+        await self.bus.emit(
+            job_id, "final",
+            {"answer": result.answer, "sources": result.sources,
+             **self._trace_summary()},
+        )
         JOBS_TOTAL.labels(status="ok").inc()
         JOB_DURATION.observe(time.monotonic() - start)
         await self.queue.set_result(job_id, {"answer": result.answer, "sources": result.sources})

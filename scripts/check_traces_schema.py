@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""CI gate: the /debug/traces JSON shape must match the committed golden.
+
+Clients (the chat UI, dashboards, the bench phase-breakdown reader) parse
+these payloads; a silent field rename would break them without any test
+noticing.  This script builds one deterministic trace through the real
+obs API, renders BOTH debug payloads with the same functions the API
+handlers call (``FlightRecorder.summaries_payload`` / ``trace_payload``),
+reduces them to a type-shape schema, and diffs against
+``tests/golden/debug_traces_schema.json``.
+
+    python scripts/check_traces_schema.py            # verify (CI)
+    python scripts/check_traces_schema.py --write    # intentional change
+
+An intentional schema change regenerates the golden with --write and
+ships the diff in the same PR.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+GOLDEN = REPO / "tests" / "golden" / "debug_traces_schema.json"
+
+
+def shape(value):
+    """Recursive type-shape: dict keys are part of the schema, values
+    reduce to type names, lists reduce to the first element's shape."""
+    if isinstance(value, dict):
+        return {k: shape(v) for k, v in sorted(value.items())}
+    if isinstance(value, list):
+        return [shape(value[0])] if value else []
+    if isinstance(value, bool):
+        return "bool"
+    if isinstance(value, (int, float)):
+        return "number"
+    if value is None:
+        return "null"
+    return type(value).__name__
+
+
+def build_payloads():
+    """One synthetic trace exercising every field both payloads can emit:
+    nested spans, attrs, events, an error status, known phase names."""
+    os.environ["TRACE_SAMPLE"] = "1"
+    from githubrepostorag_tpu.obs import reset_recorder, root_span, span
+    from githubrepostorag_tpu.obs.trace import record_span
+
+    recorder = reset_recorder()
+    with root_span("http POST /rag/jobs") as sp:
+        sp.set_attr("status", 200)
+        with span("agent.plan") as child:
+            child.add_event("xla_compile", new_programs=1)
+        with span("agent.synthesize") as child:
+            child.set_status("error: demo")
+        ctx = sp.context
+    record_span("engine.prefill", sp.start, sp.start + 0.001, parent=ctx,
+                attrs={"prompt_tokens": 4})
+    trace_id = recorder.trace_ids()[0]
+    return recorder.summaries_payload(), recorder.trace_payload(trace_id)
+
+
+def main() -> int:
+    summaries, detail = build_payloads()
+    current = {
+        "GET /debug/traces": shape(summaries),
+        "GET /debug/traces/{trace_id}": shape(detail),
+    }
+    if "--write" in sys.argv:
+        GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+        GOLDEN.write_text(json.dumps(current, indent=2, sort_keys=True) + "\n")
+        print(f"wrote {GOLDEN.relative_to(REPO)}")
+        return 0
+    if not GOLDEN.exists():
+        print(f"missing golden {GOLDEN.relative_to(REPO)}; run with --write", file=sys.stderr)
+        return 1
+    golden = json.loads(GOLDEN.read_text())
+    if golden != current:
+        print("/debug/traces schema drifted from the committed golden.", file=sys.stderr)
+        print("golden:  " + json.dumps(golden, sort_keys=True), file=sys.stderr)
+        print("current: " + json.dumps(current, sort_keys=True), file=sys.stderr)
+        print("If intentional: python scripts/check_traces_schema.py --write", file=sys.stderr)
+        return 1
+    print("debug/traces schema matches golden")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,6 +7,9 @@ cd "$(dirname "$0")/.."
 echo "== tpulint =="
 make lint
 
+echo "== /debug/traces schema =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_traces_schema.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -1,0 +1,16 @@
+"""OBS001 negative: monotonic duration math; wall clock only as a stamp."""
+import time
+
+
+def span_duration(start):
+    return time.monotonic() - start  # the correct elapsed-time clock
+
+
+def wire_envelope(budget_ms):
+    # epoch stamps crossing a process boundary are the legitimate
+    # time.time() use: serialized, never subtracted locally
+    return {"budget_ms": budget_ms, "t0": time.time()}
+
+
+def created_field():
+    return {"created": int(time.time())}  # display/wire timestamp

@@ -1,0 +1,14 @@
+"""OBS001 positive: wall-clock time.time() in duration/ordering math."""
+import time
+
+
+def span_duration(start):
+    return time.time() - start  # wall clock steps under NTP slew
+
+
+def deadline_expired(deadline_ts):
+    return time.time() > deadline_ts  # ordering compare on the wall clock
+
+
+def transit_correction(t0):
+    return max(0.0, time.time() - t0)  # nested inside a call, still math

@@ -28,7 +28,8 @@ from tools.tpulint.reporters import render_json, render_rule_list, render_text  
 from tools.tpulint.rules import RULES  # noqa: E402
 
 FIXTURES = REPO / "tests" / "lint_fixtures"
-RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "ASY001", "ASY002"]
+RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
+            "ASY001", "ASY002", "OBS001"]
 
 
 # ------------------------------------------------------------------ registry

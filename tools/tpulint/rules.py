@@ -822,3 +822,40 @@ def check_asy002(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
                                 "— two tasks can both pass the check; hold an "
                                 "asyncio.Lock around the whole span",
                             )
+
+
+# --------------------------------------------------------------------------
+# OBS001 — wall-clock time.time() used in duration/ordering arithmetic
+
+
+@register(
+    "OBS001",
+    "time.time() used for duration math",
+    "Wall clocks step backwards under NTP slew and drift across cores; "
+    "subtracting or comparing time.time() values corrupts span durations "
+    "and deadline ordering. Use time.monotonic() for elapsed-time math "
+    "(time.time() stays fine as a display/wire timestamp).",
+)
+def check_obs001(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    def walltime_calls(node: ast.AST) -> Iterator[ast.Call]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and dotted(sub.func) in ("time.time", "time"):
+                yield sub
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            operands: list[ast.AST] = [node.left, node.right]
+        elif isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE)) for op in node.ops
+        ):
+            operands = [node.left, *node.comparators]
+        else:
+            continue
+        for operand in operands:
+            for call in walltime_calls(operand):
+                yield (
+                    call.lineno, call.col_offset,
+                    "time.time() in duration/ordering arithmetic — wall "
+                    "clocks drift and step; use time.monotonic() for "
+                    "elapsed-time math",
+                )
