@@ -719,6 +719,93 @@ def bench_retrieval_pair(tag: str, *, n_docs: int, dim: int, concurrency: int,
     return {"speedup": speedup, **{p: out[p] for p in out}}
 
 
+def bench_spec_pair(tag: str, *, streams: int = 8, prompt_len: int = 32,
+                    gen_tokens: int = 64, trials: int = 3) -> dict:
+    """``spec_cpu``: draft-model speculative decoding vs plain decode
+    bursts on the SAME prompts — the serving-path A/B the acceptance gate
+    reads.  Target and draft are independently-initialized cycle
+    narrators (zero layers + rolled untied lm_head: greedy argmax maps
+    token t -> t+1 through each model's OWN embedding), so the draft
+    agrees with the target on every proposal.  That isolates the
+    dispatch-path delta — spec commits up to spec_iters*(k+1) tokens per
+    device round trip vs decode_burst for the plain chain — from model
+    quality, and makes the token-identity gate exact rather than
+    statistical.  Asserts parity before reporting, then emits aggregate
+    tok/s + TTFT p95 per path and the spec/plain speedup."""
+    import dataclasses
+    from statistics import median
+
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+    def narrator(seed: int, **shape):
+        cfg = dataclasses.replace(Qwen2Config.tiny(),
+                                  tie_word_embeddings=False, **shape)
+        p = init_params(cfg, jax.random.PRNGKey(seed), dtype=jnp.float32)
+        return cfg, dict(p, layers=jax.tree.map(jnp.zeros_like, p["layers"]),
+                         lm_head=jnp.roll(p["embed"], 1, axis=0).T)
+
+    # the size asymmetry speculation exists for: the 8x-wider target (the
+    # model whose quality you're serving) runs one WIDE verify forward per
+    # spec round — k+1 positions in one efficient matmul — vs one skinny
+    # single-position forward per TOKEN on the plain path, while the tiny
+    # draft's autoregressive scan is nearly free (~1/64 the flops).  The
+    # CPU-scale analog of a 0.5B draft under a 7B target.
+    cfg, params = narrator(5, hidden_size=512, intermediate_size=1024,
+                           head_dim=128)
+    draft_cfg, dparams = narrator(6)
+    geom = dict(max_num_seqs=streams, num_pages=96, page_size=16,
+                max_seq_len=128, prefill_chunk=32, kv_dtype=jnp.float32,
+                decode_burst=8)
+    rng = np.random.default_rng(29)
+    prompts = [rng.integers(0, cfg.vocab_size - gen_tokens - 1,
+                            prompt_len).tolist() for _ in range(streams)]
+    sp = SamplingParams(max_tokens=gen_tokens, temperature=0.0,
+                        stop_token_ids=())
+    engines = {
+        "plain": Engine(params, cfg, **geom),
+        "spec": Engine(params, cfg, draft_params=dparams,
+                       draft_cfg=draft_cfg, spec_k=8, spec_iters=4, **geom),
+    }
+
+    def run(eng: Engine) -> tuple[float, float, list[list[int]]]:
+        t0 = time.monotonic()
+        res = eng.generate(prompts, sp)
+        wall = time.monotonic() - t0
+        toks = sum(len(r.output_tokens) for r in res)
+        ttfts = sorted(r.timings["first_token_t"] - r.timings["submit_t"]
+                       for r in res if "first_token_t" in r.timings)
+        p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))]
+        return toks / wall, p95, [r.output_tokens for r in res]
+
+    out, toks_by_path = {}, {}
+    for path, eng in engines.items():
+        run(eng)  # untimed warm pass compiles the shape ladder
+        samples = [run(eng) for _ in range(trials)]
+        tps = median(s[0] for s in samples)
+        p95 = median(s[1] for s in samples)
+        toks_by_path[path] = samples[-1][2]
+        out[path] = (tps, p95)
+        emit(f"{tag}_agg_tok_s_{path}", tps, "tok/s", None,
+             trial_tok_s=[round(s[0], 1) for s in samples])
+        emit(f"{tag}_ttft_p95_ms_{path}", p95 * 1e3, "ms", None)
+        log(f"bench[{tag}]: {path} {tps:.0f} tok/s agg, TTFT p95 "
+            f"{p95 * 1e3:.2f} ms ({streams} streams x {gen_tokens} tokens)")
+    # the gate: speculation is a scheduling change, never a token change
+    assert toks_by_path["spec"] == toks_by_path["plain"], \
+        "spec decode changed tokens vs plain greedy"
+    speedup = out["spec"][0] / max(out["plain"][0], 1e-9)
+    acceptance = (engines["spec"].spec_accepted
+                  / max(engines["spec"].spec_proposed, 1))
+    emit(f"{tag}_spec_tok_s_speedup", speedup, "x", None)
+    emit(f"{tag}_spec_acceptance", acceptance, "ratio", None)
+    log(f"bench[{tag}]: spec/plain aggregate tok/s {speedup:.2f}x "
+        f"at {acceptance:.2f} acceptance, token-identical")
+    return {"speedup": speedup, "acceptance": acceptance,
+            **{p: out[p] for p in out}}
+
+
 def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
     """Ingest embedding throughput (BASELINE.md asks to measure chunks/sec):
     e5-small geometry JAX BERT, length-bucketed batches."""
@@ -851,6 +938,31 @@ def _main() -> None:
                 f.write("\n")
         except OSError as exc:
             log(f"bench: could not write BENCH_retrieval_cpu.json ({exc})")
+        # spec-vs-plain serving path A/B at CPU scale: the win is
+        # dispatch-count-relative (spec_iters*(k+1) committed tokens per
+        # round trip vs decode_burst), so it shows on CPU too
+        before = len(_RECORDS)
+        spec = bench_spec_pair("spec_conc8_cpu")
+        recs = _RECORDS[before:]
+        try:
+            with open(os.path.join(os.path.dirname(__file__) or ".",
+                                   "BENCH_spec_cpu.json"), "w") as f:
+                json.dump({
+                    "scenario": ("spec_conc8 (CPU A/B; draft-model "
+                                 "speculative decoding vs plain bursts)"),
+                    "platform": "cpu",
+                    "note": (
+                        "cycle-narrator target+draft pair, 8 streams x 64 "
+                        "greedy tokens, token-identical outputs asserted. "
+                        f"Spec/plain aggregate tok/s: "
+                        f"{spec['speedup']:.2f}x at "
+                        f"{spec['acceptance']:.2f} acceptance."),
+                    "records": recs,
+                    "summary": {r["metric"]: r["value"] for r in recs},
+                }, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as exc:
+            log(f"bench: could not write BENCH_spec_cpu.json ({exc})")
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------

@@ -243,6 +243,29 @@ class Settings:
     spec_burst_iters: int = field(
         default_factory=lambda: _env_int("SPEC_BURST_ITERS", 0)
     )
+    # path to a small draft checkpoint (e.g. Qwen2.5-0.5B next to a 7B
+    # target): when set, DRAFT-MODEL speculative decoding becomes the
+    # serving default (serving/draft_spec.py) — draft k tokens on the
+    # small model, verify all of them in one target forward, commit the
+    # longest agreed prefix.  Mutually exclusive with SPEC_NGRAM_K.
+    spec_draft_model: str = field(
+        default_factory=lambda: os.getenv("SPEC_DRAFT_MODEL", "")
+    )
+    # max draft length per round; the adaptive controller walks the
+    # power-of-two ladder [1..SPEC_K] on EMA acceptance rate
+    spec_k: int = field(default_factory=lambda: _env_int("SPEC_K", 4))
+    # fused draft/verify/accept rounds per device dispatch
+    spec_iters: int = field(default_factory=lambda: _env_int("SPEC_ITERS", 4))
+    # a request whose EMA acceptance rate falls below this floor drops to
+    # plain decode_burst for the rest of its life (sticky fallback)
+    spec_accept_floor: float = field(
+        default_factory=lambda: _env_float("SPEC_ACCEPT_FLOOR", 0.35)
+    )
+    # requests within this margin of their propagated deadline also fall
+    # back: plain decode stops at finer granularity than a spec burst
+    spec_deadline_margin_s: float = field(
+        default_factory=lambda: _env_float("SPEC_DEADLINE_MARGIN_S", 0.25)
+    )
     # int8 KV cache pages with per-token dequant scales: halves KV reads
     # and doubles effective page capacity (kv_cache.quantize_kv_paged:
     # per-page scales riding the decode kernel's scalar-prefetch channel)

@@ -90,6 +90,32 @@ SPEC_ACCEPTED = Counter(
     "Speculative draft tokens the model accepted and committed",
     registry=REGISTRY,
 )
+# literal-name aliases for the draft-model speculation dashboards (the
+# *_tokens_total pair above predates the draft-model path and keeps its
+# names for dashboard compatibility; both pairs advance together)
+SPEC_PROPOSED_TOTAL = Counter(
+    "rag_spec_proposed_total",
+    "Draft tokens proposed by the speculative decoder (n-gram or draft model)",
+    registry=REGISTRY,
+)
+SPEC_ACCEPTED_TOTAL = Counter(
+    "rag_spec_accepted_total",
+    "Proposed draft tokens the target model accepted and committed",
+    registry=REGISTRY,
+)
+SPEC_FALLBACKS = Counter(
+    "rag_spec_fallbacks_total",
+    "Requests the adaptive controller demoted from speculative to plain "
+    "decode, by reason (acceptance collapse / deadline pressure)",
+    ["reason"],
+    registry=REGISTRY,
+)
+SPEC_ACCEPTANCE = Histogram(
+    "rag_spec_acceptance_ratio",
+    "Per-request draft acceptance ratio (accepted / proposed) at completion",
+    registry=REGISTRY,
+    buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
+)
 WORKER_DEQUEUE_ERRORS = Counter(
     "rag_worker_dequeue_errors_total",
     "queue.dequeue() failures survived by the worker's backoff loop",

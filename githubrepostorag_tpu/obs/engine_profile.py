@@ -47,6 +47,7 @@ DEFAULT_JIT_MODULES = (
     "githubrepostorag_tpu.serving.engine",
     "githubrepostorag_tpu.serving.decode_burst",
     "githubrepostorag_tpu.serving.spec_burst",
+    "githubrepostorag_tpu.serving.draft_spec",
     "githubrepostorag_tpu.serving.long_prefill",
     "githubrepostorag_tpu.models.qwen2",
     "githubrepostorag_tpu.ops.sampling",
@@ -180,7 +181,22 @@ def record_engine_spans(result: Any, parent: TraceContext | None) -> None:
             **attrs, "prompt_tokens": len(getattr(result, "prompt_tokens", ()) or ()),
         })
     if ftok is not None and done > ftok:
-        record_span("engine.decode", ftok, done, parent=parent, attrs={
+        sp = record_span("engine.decode", ftok, done, parent=parent, attrs={
             **attrs, "output_tokens": len(getattr(result, "output_tokens", ()) or ()),
             "finish_reason": getattr(result, "finish_reason", ""),
         })
+        if sp is not None:
+            # speculative-decoding outcome as events on the decode span:
+            # the flight recorder then shows per-request acceptance and
+            # any controller fallback right in the request's timeline
+            proposed = getattr(result, "spec_proposed", 0)
+            if proposed:
+                sp.add_event(
+                    "spec", proposed=proposed,
+                    accepted=getattr(result, "spec_accepted", 0),
+                    acceptance=round(
+                        getattr(result, "spec_accepted", 0) / proposed, 4),
+                )
+            fallback = getattr(result, "spec_fallback", None)
+            if fallback:
+                sp.add_event("spec_fallback", reason=fallback)

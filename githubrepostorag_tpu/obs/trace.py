@@ -285,16 +285,19 @@ def root_span(name: str, wire: Any = None, **attrs: Any) -> Iterator[Span | _Noo
 def record_span(name: str, start: float, end: float,
                 parent: TraceContext | None = None,
                 attrs: dict[str, Any] | None = None,
-                status: str = "ok") -> None:
+                status: str = "ok") -> "Span | None":
     """Record a retroactive span from already-measured monotonic
     timestamps (engine queue/prefill/decode attribution, coalescer wave
-    timing) under ``parent`` or the active scope.  No-op when untraced."""
+    timing) under ``parent`` or the active scope.  Returns the finished
+    span so callers can stamp events on it (record_engine_spans annotates
+    the decode span with speculation outcomes); None when untraced."""
     ctx = parent if parent is not None else current_context()
     if ctx is None or not ctx.sampled:
-        return
+        return None
     sp = Span(name, ctx, start=start)
     if attrs:
         for key, value in attrs.items():
             sp.set_attr(key, value)
     sp.status = status
     sp.finish(end=end)
+    return sp

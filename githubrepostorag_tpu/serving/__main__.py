@@ -93,6 +93,23 @@ async def serve(host: str, port: int) -> None:
         fuse=plan.n_devices == 1,  # mesh=None below iff the plan is one chip
     )
 
+    draft_params = draft_cfg = None
+    if s.spec_draft_model:
+        # draft-model speculation pairing (ROADMAP: 0.5B draft + 7B int8
+        # target).  The draft loads UNQUANTIZED and UNFUSED — the Engine
+        # fuses/replicates it itself — and must share the target's
+        # tokenizer (the Engine rejects a vocab mismatch at construction).
+        if s.spec_ngram_k:
+            raise SystemExit(
+                "SPEC_DRAFT_MODEL and SPEC_NGRAM_K are mutually exclusive: "
+                "a serving pod runs one speculation strategy"
+            )
+        logger.info("loading draft model from %s", s.spec_draft_model)
+        draft_params, draft_cfg = load_qwen2(
+            s.spec_draft_model, dtype=ml_dtypes.bfloat16,
+            moe_capacity_factor=s.moe_capacity_factor,
+        )
+
     # tokenizer first: a broken tokenizer config must fail fast, not after
     # minutes of XLA warmup compiles
     tokenizer = make_tokenizer(s.model_weights_path)
@@ -116,6 +133,12 @@ async def serve(host: str, port: int) -> None:
             sp_prefill_threshold=s.sp_prefill_threshold or None,
             spec_ngram_k=s.spec_ngram_k,
             spec_burst_iters=s.spec_burst_iters,
+            draft_params=draft_params,
+            draft_cfg=draft_cfg,
+            spec_k=s.spec_k,
+            spec_iters=s.spec_iters,
+            spec_accept_floor=s.spec_accept_floor,
+            spec_deadline_margin_s=s.spec_deadline_margin_s,
         )
 
     if plan.dp > 1:

@@ -45,7 +45,8 @@ class AsyncEngine:
         # instance state, so a stop()/start() relaunch doesn't re-export
         # the full cumulative totals
         self._exported = {"hit": 0, "prop": 0, "acc": 0,
-                          "packed_tok": 0, "packed_pad": 0, "reaps": 0}
+                          "packed_tok": 0, "packed_pad": 0, "reaps": 0,
+                          "fb": {}}
         # step profiler: scheduler-stall gauge + XLA compile watchdog,
         # sampled once per step on the driver thread (obs/engine_profile)
         self.profiler = EngineStepProfiler()
@@ -80,8 +81,12 @@ class AsyncEngine:
             PACKED_PREFILL_PADDING,
             PACKED_PREFILL_TOKENS,
             PREFIX_CACHE_HITS,
+            SPEC_ACCEPTANCE,
             SPEC_ACCEPTED,
+            SPEC_ACCEPTED_TOTAL,
+            SPEC_FALLBACKS,
             SPEC_PROPOSED,
+            SPEC_PROPOSED_TOTAL,
             TTFT,
         )
 
@@ -93,8 +98,17 @@ class AsyncEngine:
             ptok = getattr(self.engine, "packed_prefill_tokens", 0)
             ppad = getattr(self.engine, "packed_prefill_padding", 0)
             PREFIX_CACHE_HITS.inc(hit - last["hit"])
-            SPEC_PROPOSED.inc(self.engine.spec_proposed - last["prop"])
-            SPEC_ACCEPTED.inc(self.engine.spec_accepted - last["acc"])
+            d_prop = self.engine.spec_proposed - last["prop"]
+            d_acc = self.engine.spec_accepted - last["acc"]
+            SPEC_PROPOSED.inc(d_prop)
+            SPEC_ACCEPTED.inc(d_acc)
+            SPEC_PROPOSED_TOTAL.inc(d_prop)
+            SPEC_ACCEPTED_TOTAL.inc(d_acc)
+            for reason, n in getattr(self.engine, "spec_fallbacks", {}).items():
+                prev = last["fb"].get(reason, 0)
+                if n > prev:
+                    SPEC_FALLBACKS.labels(reason=reason).inc(n - prev)
+                    last["fb"][reason] = n
             PACKED_PREFILL_TOKENS.inc(ptok - last["packed_tok"])
             PACKED_PREFILL_PADDING.inc(ppad - last["packed_pad"])
             reaps = self.engine.deadline_reaps
@@ -124,6 +138,8 @@ class AsyncEngine:
                 decoded = len(res.output_tokens) - 1  # first token is prefill's
                 if decoded > 0 and res.decode_time_s > 0:
                     TPOT.observe(res.decode_time_s / decoded)
+                if res.spec_proposed > 0:
+                    SPEC_ACCEPTANCE.observe(res.spec_accepted / res.spec_proposed)
                 self._emit(res.request_id, StreamEvent(type="final", result=res))
             if not has_work:
                 self._wake.wait(timeout=0.02)
@@ -199,5 +215,13 @@ class AsyncEngine:
                 ),
                 "spec_proposed": self.engine.spec_proposed,
                 "spec_accepted": self.engine.spec_accepted,
+                # rate-suffixed: MultiAsyncEngine.stats() averages this
+                # across replicas instead of summing it
+                "spec_acceptance_rate": (
+                    self.engine.spec_accepted / max(1, self.engine.spec_proposed)
+                ),
+                "spec_fallbacks": sum(
+                    getattr(self.engine, "spec_fallbacks", {}).values()
+                ),
                 "deadline_reaps": self.engine.deadline_reaps,
             }

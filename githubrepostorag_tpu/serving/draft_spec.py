@@ -1,0 +1,179 @@
+"""Fused DRAFT-MODEL speculative decode bursts: a second (small) model
+proposes, the target verifies — entirely on-device.
+
+The n-gram fused path (serving/spec_burst.py) made speculation free of the
+per-verify dispatch round trip, but its drafter only wins on quoting-heavy
+outputs: a bigram prompt-lookup has nothing to say on novel text.  This
+module swaps the lookup for a real draft model (ROADMAP's 0.5B-draft +
+7B-int8-target pairing): the draft holds its OWN page pools, indexed by the
+SAME block tables as the target, so the two caches stay position-aligned by
+construction and prefix-cache pages carry valid KV for both models (the
+engine runs every prefill chunk through both).
+
+Design, per iteration (all [B]-vectorized, one compiled program per
+(k, row-bucket) pair):
+  1. DRAFT: ``k + 1`` autoregressive single-token forwards of the draft
+     model inside a ``lax.scan`` — step j feeds the newest token at
+     position lens+j and argmaxes the next.  Steps 0..k-1 yield the k
+     draft tokens; step k is write-only (it commits the would-be
+     correction position's draft KV so a fully-accepted round leaves the
+     draft cache covering every committed token — the invariant that
+     lets the next round resume with cached_lens == target seq_len).
+  2. VERIFY: one target ``forward_paged_impl`` over [last, draft...] —
+     k+1 positions read the target weights ONCE, which is the whole
+     speculative bet in the weight-bandwidth-bound decode regime.
+  3. ACCEPT: longest model-agreed draft prefix + the target's correction
+     token (cumprod of the agreement mask) — greedy-token-identical to
+     plain decode by construction.
+
+Greedy-only by design (same eligibility rule as the n-gram paths); the
+engine's adaptive controller picks ``k`` per dispatch from a precompiled
+power-of-two ladder and falls back to plain ``decode_burst`` when
+acceptance collapses or a deadline is at risk (serving/engine.py).
+
+The draft pools are always full-precision (never kv_quant): the draft
+model is small enough that quantizing its cache buys nothing, and keeping
+it exact means a draft/target disagreement is always a real model
+disagreement, not a draft-side quantization artifact.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, forward_paged_impl
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "n_iters", "k", "use_pallas",
+                     "int4_kernel"),
+    donate_argnums=(7, 8, 9, 10),
+)
+def draft_spec_burst(
+    params: dict,
+    draft_params: dict,
+    cfg: Qwen2Config,
+    draft_cfg: Qwen2Config,
+    history: jnp.ndarray,  # [B, H] int32 — prompt + committed output
+    hist_lens: jnp.ndarray,  # [B] int32
+    lens: jnp.ndarray,  # [B] int32 cached tokens (== hist_lens - 1 for
+    # running rows: the newest committed token is not yet cached — the
+    # SAME position convention for both models' pools)
+    k_pages: jnp.ndarray,  # donated (target)
+    v_pages: jnp.ndarray,  # donated (target)
+    dk_pages: jnp.ndarray,  # donated (draft)
+    dv_pages: jnp.ndarray,  # donated (draft)
+    block_tables: jnp.ndarray,  # [B, max_pages] int32 — shared by both pools
+    row_limits: jnp.ndarray,  # [B] int32 max cacheable tokens
+    active: jnp.ndarray,  # [B] bool
+    *,
+    n_iters: int,
+    k: int,
+    use_pallas: bool = False,
+    int4_kernel: bool = True,
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
+):
+    """Run ``n_iters`` fused draft-model draft/verify/accept iterations.
+
+    Returns (tokens [B, n_iters, k+1] int32 with -1 padding — committed
+    tokens in order, the decode_burst packing contract per iteration —
+    proposed [B, n_iters] draft lengths, k_pages, v_pages, dk_pages,
+    dv_pages[, k_scales, v_scales]).  Token outputs are identical to plain
+    greedy decoding regardless of how good the draft model is."""
+    b, h = history.shape
+    width = k + 1
+    rows = jnp.arange(b)
+    page_size = k_pages.shape[3]
+    quant = k_scales is not None
+    ones = jnp.ones((b,), dtype=jnp.int32)
+
+    def one_iter(carry, _):
+        history, hist_lens, lens, active, kp, vp, dkp, dvp, ks, vs = carry
+        act = active & (lens + 1 <= row_limits)
+        last = history[rows, jnp.maximum(hist_lens - 1, 0)]  # [B]
+
+        def draft_step(dc, j):
+            tok, dkp, dvp = dc
+            p = lens + j  # [B] — position of the token this step feeds
+            page_idx = jnp.clip(p // page_size, 0, block_tables.shape[1] - 1)
+            slot = (
+                jnp.take_along_axis(block_tables, page_idx[:, None], axis=1)[:, 0]
+                * page_size + p % page_size
+            )
+            # never scatter past a row's allocated pages (-1 drops); the
+            # write-only step k lands inside the limit exactly when a full
+            # accept could need it (dlen == k requires lens + k < limits)
+            slot = jnp.where(act & (p < row_limits), slot, -1)
+            dlogits, dkp, dvp = forward_paged_impl(
+                draft_params, draft_cfg, tok[:, None], p[:, None], dkp, dvp,
+                slot[:, None], block_tables, p, ones, use_pallas,
+                int4_kernel=int4_kernel,
+            )
+            nxt = jnp.argmax(dlogits[:, 0], axis=-1).astype(jnp.int32)
+            return (nxt, dkp, dvp), nxt
+
+        (_, dkp, dvp), d_all = jax.lax.scan(
+            draft_step, (last, dkp, dvp), jnp.arange(k + 1)
+        )
+        draft = jnp.swapaxes(d_all, 0, 1)[:, :k]  # step k's token: write-only
+
+        # leave room for the correction token inside the row's page budget
+        dlen = jnp.minimum(k, jnp.maximum(row_limits - lens - 1, 0))
+        dlen = jnp.where(act, dlen, 0).astype(jnp.int32)
+        ids = jnp.concatenate([last[:, None], draft], axis=1)  # [B, width]
+        pos = lens[:, None] + jnp.arange(width)[None, :]
+        n_new = jnp.where(act, 1 + dlen, 0).astype(jnp.int32)
+        in_window = jnp.arange(width)[None, :] < n_new[:, None]
+        page_idx = jnp.clip(pos // page_size, 0, block_tables.shape[1] - 1)
+        slots = jnp.take_along_axis(block_tables, page_idx, axis=1) * page_size \
+            + pos % page_size
+        slots = jnp.where(in_window, slots, -1)  # -1 drops at the scatter
+
+        out = forward_paged_impl(
+            params, cfg, ids, pos, kp, vp, slots, block_tables,
+            lens, n_new, use_pallas, int4_kernel=int4_kernel,
+            k_scales=ks if quant else None, v_scales=vs if quant else None,
+        )
+        if quant:
+            logits, kp, vp, ks, vs = out
+        else:
+            logits, kp, vp = out
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, width]
+
+        # longest agreed prefix: a = number of leading draft positions the
+        # target reproduces; commit greedy[:, :a+1] (the a agreed tokens ARE
+        # greedy's, plus its correction at position a)
+        agree = (greedy[:, :k] == draft) & (jnp.arange(k)[None, :] < dlen[:, None])
+        a = jnp.cumprod(agree.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+        n_commit = jnp.where(act, a + 1, 0).astype(jnp.int32)
+        committed = jnp.arange(width)[None, :] < n_commit[:, None]
+        toks = jnp.where(committed, greedy, -1)
+
+        # append committed tokens to the history (out-of-range -> drop)
+        hidx = hist_lens[:, None] + jnp.arange(width)[None, :]
+        hidx = jnp.where(committed & (hidx < h), hidx, h)
+        history = history.at[rows[:, None], hidx].set(greedy, mode="drop")
+        hist_lens = hist_lens + n_commit
+        lens = lens + n_commit
+
+        carry = (history, hist_lens, lens, active, kp, vp, dkp, dvp, ks, vs)
+        return carry, (toks, dlen)
+
+    ks0 = k_scales if quant else jnp.zeros((), jnp.float32)
+    vs0 = v_scales if quant else jnp.zeros((), jnp.float32)
+    carry0 = (history, hist_lens, lens, active,
+              k_pages, v_pages, dk_pages, dv_pages, ks0, vs0)
+    (history, hist_lens, lens, active, k_pages, v_pages, dk_pages, dv_pages,
+     ks, vs), (toks, proposed) = jax.lax.scan(
+        one_iter, carry0, None, length=n_iters)
+    # scan stacks leading: [n_iters, B, ...] -> [B, n_iters, ...]
+    toks = jnp.swapaxes(toks, 0, 1)
+    proposed = jnp.swapaxes(proposed, 0, 1)
+    if quant:
+        return toks, proposed, k_pages, v_pages, dk_pages, dv_pages, ks, vs
+    return toks, proposed, k_pages, v_pages, dk_pages, dv_pages

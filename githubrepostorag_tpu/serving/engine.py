@@ -72,6 +72,13 @@ class GenerationResult:
     # done_t) for trace attribution — obs/engine_profile.record_engine_spans
     # turns these into queue-wait / prefill / decode spans
     timings: dict | None = None
+    # draft-model speculation accounting: tokens the draft proposed for
+    # this request, tokens the target accepted, and the sticky fallback
+    # reason if the adaptive controller demoted the request to plain
+    # decode ("acceptance" | "deadline" | None)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    spec_fallback: str | None = None
 
 
 @dataclass
@@ -99,6 +106,13 @@ class _Request:
     # caller that stopped waiting
     deadline_ts: float | None = None
     deadline_expired: bool = False
+    # draft-model speculation controller state (engine.spec path): EMA of
+    # per-dispatch acceptance rate drives the k ladder; a sticky fallback
+    # reason demotes the request to plain decode for the rest of its life
+    spec_accept_ema: float | None = None
+    spec_fallback: str | None = None
+    spec_proposed_req: int = 0
+    spec_accepted_req: int = 0
 
 
 from githubrepostorag_tpu.utils import next_bucket as _bucket
@@ -168,6 +182,25 @@ class Engine:
         # (serving/spec_burst.py) whenever every running row is plain
         # greedy — removes the per-verify dispatch round trip that made
         # host-dispatched spec decode a measured loss (BENCH r03/r04)
+        draft_params: dict | None = None,  # DRAFT-MODEL speculation (the
+        # default serving path when set — SPEC_DRAFT_MODEL): a second,
+        # small model drafts k tokens autoregressively on its own KV
+        # pages, the target verifies all k+1 positions in one forward,
+        # and the longest agreed prefix + correction token commits —
+        # greedy-token-identical to plain decode (serving/draft_spec.py).
+        # Mutually exclusive with spec_ngram_k.
+        draft_cfg: Qwen2Config | None = None,
+        spec_k: int = 4,  # max draft length; the adaptive controller picks
+        # each dispatch's k from the power-of-two ladder [1, 2, ..., spec_k]
+        # (warmup precompiles every rung) driven by EMA acceptance
+        spec_iters: int = 4,  # fused draft/verify/accept rounds per dispatch
+        spec_accept_floor: float = 0.35,  # a request whose EMA acceptance
+        # rate drops below this falls back to plain decode_burst for the
+        # rest of its life (sticky) — speculation that mostly misses costs
+        # a draft pass + a wider verify for ~1 token/round
+        spec_deadline_margin_s: float = 0.25,  # requests within this margin
+        # of their propagated deadline also fall back: the burst-sized
+        # spec dispatch has coarser stop granularity than plain decode
     ) -> None:
         self.mesh = mesh
         if mesh is not None:
@@ -268,8 +301,67 @@ class Engine:
                 "SPEC_NGRAM_K it would silently do nothing)"
             )
         self.spec_burst_iters = spec_burst_iters
+
+        # ---- draft-model speculation (the default serving path when a
+        # draft is configured — serving/draft_spec.py) ----
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("draft_params and draft_cfg must be set together")
+        if draft_params is not None and spec_ngram_k > 0:
+            raise ValueError(
+                "draft-model speculation and n-gram speculation are mutually "
+                "exclusive; unset SPEC_NGRAM_K or SPEC_DRAFT_MODEL"
+            )
+        self._draft_enabled = draft_params is not None
+        self.draft_cfg = draft_cfg
+        self.spec_k = spec_k
+        self.spec_iters = spec_iters
+        self.spec_accept_floor = spec_accept_floor
+        self.spec_deadline_margin_s = spec_deadline_margin_s
+        self.draft_params = None
+        self._dk_pages = self._dv_pages = None
+        self._force_plain = False  # warmup hook: route through _decode_step
+        self._spec_k_ladder: list[int] = []
+        if self._draft_enabled:
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                # accept/verify compares token IDs across the two models —
+                # they must share a vocabulary (ROADMAP pairs same-family
+                # Qwen2 checkpoints)
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}; draft and target must share a tokenizer"
+                )
+            if spec_k < 1 or spec_iters < 1:
+                raise ValueError("spec_k and spec_iters must be >= 1")
+            if mesh is not None:
+                # the draft is small: replicate rather than shard (its
+                # head counts need not divide tp, and replicated weights
+                # keep the inner autoregressive scan communication-free)
+                self.draft_params = jax.device_put(draft_params, self._replicated)
+            else:
+                from githubrepostorag_tpu.models.quant import fuse_projections
+
+                self.draft_params = fuse_projections(draft_params)
+            # the draft's own KV pages, indexed by the SAME block tables as
+            # the target (one allocator, two pools) — never quantized
+            dpools = make_page_pools(draft_cfg, num_pages, page_size,
+                                     dtype=kv_dtype, quant=False)
+            self._dk_pages, self._dv_pages = dpools.k, dpools.v
+            if mesh is not None:
+                self._dk_pages = jax.device_put(self._dk_pages, self._replicated)
+                self._dv_pages = jax.device_put(self._dv_pages, self._replicated)
+            # power-of-two k ladder, largest rung = spec_k: warmup compiles
+            # one program per (rung, row bucket); the controller only ever
+            # dispatches at a rung, so live traffic can't mint new shapes
+            rung = 1
+            while rung < spec_k:
+                self._spec_k_ladder.append(rung)
+                rung *= 2
+            self._spec_k_ladder.append(spec_k)
+            self._spec_k_ladder = sorted(set(self._spec_k_ladder))
+
         self.spec_proposed = 0  # stats: draft tokens offered / accepted
         self.spec_accepted = 0
+        self.spec_fallbacks: dict[str, int] = {}  # fallback counts by reason
         self.requests_admitted = 0  # cumulative add_request count
         self.deadline_reaps = 0  # requests reaped past their deadline
 
@@ -411,7 +503,17 @@ class Engine:
             # and decode always runs (which is also what frees pages).
             running = []
         if running:
-            if self.spec_ngram_k > 0:
+            if self._draft_enabled and not self._force_plain:
+                capable = [r for r in running if self._spec_capable(r)]
+                if capable and len(capable) == len(running):
+                    self._draft_spec_step(finished)
+                else:
+                    # mixed batch: one sampling/fallen row demotes the whole
+                    # dispatch to plain decode (the spec burst is greedy-only
+                    # and batch-shaped).  Rows that were individually capable
+                    # stay capable — the mix is per-step, not sticky.
+                    self._decode_step(finished)
+            elif self.spec_ngram_k > 0:
                 all_greedy = all(
                     r.sampling.temperature <= 0.0
                     and r.sampling.repetition_penalty == 1.0
@@ -474,12 +576,34 @@ class Engine:
 
     def _sp_eligible(self, req: _Request) -> bool:
         """Long prompts take the sequence-parallel ring-prefill path: the
-        whole prompt in one program, attention sharded over sp."""
+        whole prompt in one program, attention sharded over sp.  Disabled
+        under draft-model speculation: ring prefill writes only target KV,
+        and a row whose draft cache is missing its prompt could never
+        speculate (the chunked path runs every chunk through both models)."""
         return (
             self.sp_prefill_threshold is not None
+            and not self._draft_enabled
             and self._sp > 1
             and len(req.prompt) >= self.sp_prefill_threshold
         )
+
+    def _commit_first_now(self, others_running: bool) -> bool:
+        """Whether a freshly-prefilled row's first token commits with an
+        immediate host sync (best TTFT) instead of queueing on device into
+        ``_pending_first`` for the next decode dispatch.  The single source
+        of truth for all three prefill paths:
+          - n-gram spec modes are synchronous by design -> always commit;
+          - draft-model spec is synchronous too, but a plain-decode chain
+            may be in flight (mixed-batch/fallback steps pipeline) and its
+            stale device state must not race a fresh commit -> commit only
+            when no chain is live;
+          - plain decode additionally defers whenever other rows are
+            running, so admissions never stall streams on a host sync."""
+        if self.spec_ngram_k > 0:
+            return True
+        if self._draft_enabled:
+            return self._chain is None
+        return self._chain is None and not others_running
 
     def _dispatch_width(self, longest_chunk: int) -> int:
         """Prefill dispatch width for a wave whose longest pending chunk is
@@ -677,14 +801,18 @@ class Engine:
         last_idx = np.zeros((rb,), dtype=np.int32)
         for i, v in enumerate(valids):
             last_idx[i] = v - 1
+        ids_d, pos_d = jnp.asarray(ids), jnp.asarray(pos)
+        slots_d, bt_d = jnp.asarray(slots), jnp.asarray(bt)
+        cached_d, new_lens_d = jnp.asarray(cached), jnp.asarray(new_lens)
+        last_idx_d = jnp.asarray(last_idx)
         with annotate("engine.prefill_batch"):
             out = forward_paged(
                 self.params, self.cfg,
-                jnp.asarray(ids), jnp.asarray(pos),
+                ids_d, pos_d,
                 self._k_pages, self._v_pages,
-                jnp.asarray(slots), jnp.asarray(bt),
-                jnp.asarray(cached), jnp.asarray(new_lens),
-                use_pallas=self.use_pallas, logits_at=jnp.asarray(last_idx),
+                slots_d, bt_d,
+                cached_d, new_lens_d,
+                use_pallas=self.use_pallas, logits_at=last_idx_d,
                 k_scales=self._k_scales, v_scales=self._v_scales,
                 int4_kernel=self._int4_kernel,
             )
@@ -693,6 +821,22 @@ class Engine:
                  self._k_scales, self._v_scales) = out
             else:
                 logits, self._k_pages, self._v_pages = out
+        if self._draft_enabled:
+            # the draft model prefills the SAME chunk into its own pools
+            # (same slots/block tables — the pools are position-aligned by
+            # construction), so decode-time drafting always has the full
+            # prompt in its cache.  Logits are discarded; the call exists
+            # for its KV writes.
+            with annotate("engine.prefill_batch_draft"):
+                _, self._dk_pages, self._dv_pages = forward_paged(
+                    self.draft_params, self.draft_cfg,
+                    ids_d, pos_d,
+                    self._dk_pages, self._dv_pages,
+                    slots_d, bt_d,
+                    cached_d, new_lens_d,
+                    use_pallas=self.use_pallas, logits_at=last_idx_d,
+                    int4_kernel=self._int4_kernel,
+                )
 
         # mark prompt tokens in the presence mask (repetition penalty input);
         # one batched scatter for the whole padded wave (padding rows have
@@ -737,7 +881,7 @@ class Engine:
         wave = [(reqs[i], i) for i in done_idx]
         for req, _ in wave:
             req.state = "running"
-        if (self._chain is None and not others_running) or self.spec_ngram_k > 0:
+        if self._commit_first_now(others_running):
             # engine idle (nothing to overlap the sync with) or speculative
             # mode (synchronous by design): commit immediately (best TTFT)
             tokens = np.asarray(tokens_d)
@@ -811,14 +955,18 @@ class Engine:
         self.packed_prefill_tokens += used
         self.packed_prefill_padding += budget - used
 
+        ids_d, pos_d = jnp.asarray(ids), jnp.asarray(pos)
+        slots_d, bt_d = jnp.asarray(slots), jnp.asarray(bt)
+        cached_d, new_lens_d = jnp.asarray(cached), jnp.asarray(new_lens)
+        seg_d, last_idx_d = jnp.asarray(seg), jnp.asarray(last_idx)
         with annotate("engine.prefill_packed"):
             out = forward_paged_packed(
                 self.params, self.cfg,
-                jnp.asarray(ids), jnp.asarray(pos),
+                ids_d, pos_d,
                 self._k_pages, self._v_pages,
-                jnp.asarray(slots), jnp.asarray(bt),
-                jnp.asarray(cached), jnp.asarray(new_lens),
-                jnp.asarray(seg), jnp.asarray(last_idx),
+                slots_d, bt_d,
+                cached_d, new_lens_d,
+                seg_d, last_idx_d,
                 tq=tq, use_pallas=self.use_pallas,
                 k_scales=self._k_scales, v_scales=self._v_scales,
                 int4_kernel=self._int4_kernel,
@@ -828,6 +976,20 @@ class Engine:
                  self._k_scales, self._v_scales) = out
             else:
                 logits, self._k_pages, self._v_pages = out
+        if self._draft_enabled:
+            # mirror the packed chunk into the draft pools (see
+            # _prefill_batch) — same packed buffer, same segment IDs
+            with annotate("engine.prefill_packed_draft"):
+                _, self._dk_pages, self._dv_pages = forward_paged_packed(
+                    self.draft_params, self.draft_cfg,
+                    ids_d, pos_d,
+                    self._dk_pages, self._dv_pages,
+                    slots_d, bt_d,
+                    cached_d, new_lens_d,
+                    seg_d, last_idx_d,
+                    tq=tq, use_pallas=self.use_pallas,
+                    int4_kernel=self._int4_kernel,
+                )
 
         row_idx = np.zeros((rb,), dtype=np.int32)
         row_idx[:n] = [req.row for req, _ in packed]
@@ -865,7 +1027,7 @@ class Engine:
         wave = [(packed[i][0], i) for i in done_idx]
         for req, _ in wave:
             req.state = "running"
-        if (self._chain is None and not others_running) or self.spec_ngram_k > 0:
+        if self._commit_first_now(others_running):
             tokens = np.asarray(tokens_d)
             for req, i in wave:
                 self._commit_token(req, int(tokens[i]), finished)
@@ -927,7 +1089,7 @@ class Engine:
         others_running = any(
             r.state == "running" and r is not req for r in self._row_req.values()
         )
-        if (self._chain is None and not others_running) or self.spec_ngram_k > 0:
+        if self._commit_first_now(others_running):
             self._commit_token(req, int(np.asarray(tokens_d)[0]), finished)
         else:
             self._pending_first.append((tokens_d, [(req, 0)]))
@@ -1098,6 +1260,146 @@ class Engine:
                 if committed:
                     # committed = agreed draft prefix + 1 correction token
                     self.spec_accepted += committed - 1
+
+    # ------------------------------------------- draft-model speculation --
+
+    def _spec_capable(self, req: _Request) -> bool:
+        """Whether this request may ride the draft-model spec burst this
+        step.  Sampling rows are simply ineligible (greedy-only path —
+        sampled parity would need rejection sampling); acceptance-collapse
+        and deadline-pressure demotions are STICKY and counted, because
+        re-probing a request the controller already gave up on would pay
+        the failed-speculation tax again every probe."""
+        if req.spec_fallback is not None:
+            return False
+        sp = req.sampling
+        if sp.temperature > 0.0 or sp.repetition_penalty != 1.0:
+            return False
+        if (
+            req.spec_accept_ema is not None
+            and req.spec_accept_ema < self.spec_accept_floor
+        ):
+            self._mark_fallback(req, "acceptance")
+            return False
+        if req.deadline_ts is not None and (
+            req.deadline_ts - time.monotonic() < self.spec_deadline_margin_s
+        ):
+            # near the propagated deadline (resilience layer, PR 4) plain
+            # decode's per-burst stop granularity beats the spec burst's
+            # spec_iters*(k+1)-token dispatch: never blow a deadline on
+            # tokens the caller will throw away
+            self._mark_fallback(req, "deadline")
+            return False
+        return True
+
+    def _mark_fallback(self, req: _Request, reason: str) -> None:
+        req.spec_fallback = reason
+        self.spec_fallbacks[reason] = self.spec_fallbacks.get(reason, 0) + 1
+
+    def _pick_spec_k(self, running: list[_Request]) -> int:
+        """Adaptive draft length: scale spec_k by the batch's mean EMA
+        acceptance rate, snapped UP to the precompiled power-of-two ladder
+        (a fresh batch with no history starts optimistic at the top rung).
+        Snapping to the ladder is what keeps the controller recompile-free:
+        every reachable k was compiled by warmup()."""
+        emas = [r.spec_accept_ema for r in running if r.spec_accept_ema is not None]
+        if not emas:
+            return self._spec_k_ladder[-1]
+        want = max(1, round((sum(emas) / len(emas)) * self.spec_k))
+        for rung in self._spec_k_ladder:
+            if rung >= want:
+                return rung
+        return self._spec_k_ladder[-1]
+
+    def _draft_spec_step(self, finished: list[GenerationResult]) -> None:
+        """One draft-model speculative dispatch (serving/draft_spec.py):
+        ``spec_iters`` fused draft/verify/accept rounds at the controller's
+        chosen k.  Synchronous like the n-gram burst — the dispatch commits
+        up to spec_iters*(k+1) tokens per row, so there is no per-token
+        round trip left to pipeline away."""
+        from githubrepostorag_tpu.serving.draft_spec import draft_spec_burst
+
+        if self._chain is not None or self._pending_first:
+            # a plain-decode chain (mixed-batch or forced-fallback steps
+            # pipeline) is in flight: land it so the history/lens snapshot
+            # below sees every committed token
+            self._drain_chain(finished)
+        running = [r for r in self._row_req.values() if r.state == "running"]
+        if not running:
+            return
+        k = self._pick_spec_k(running)
+        rb = _bucket(len(running), self.max_num_seqs, minimum=1)
+        h = self.max_seq_len
+        hist = np.zeros((rb, h), dtype=np.int32)
+        hlens = np.zeros((rb,), dtype=np.int32)
+        lens = np.zeros((rb,), dtype=np.int32)
+        bt = np.zeros((rb, self.max_pages_per_seq), dtype=np.int32)
+        limits = np.zeros((rb,), dtype=np.int32)
+        active = np.zeros((rb,), dtype=bool)
+        for i, req in enumerate(running):
+            toks = (req.prompt + req.output)[-h:]
+            hist[i, : len(toks)] = toks
+            hlens[i] = len(toks)
+            lens[i] = req.seq_len
+            bt[i] = self._block_tables[req.row]
+            limits[i] = self._row_limits[req.row]
+            active[i] = True
+
+        with annotate("engine.draft_spec_burst"):
+            out = draft_spec_burst(
+                self.params, self.draft_params, self.cfg, self.draft_cfg,
+                jnp.asarray(hist), jnp.asarray(hlens), jnp.asarray(lens),
+                self._k_pages, self._v_pages,
+                self._dk_pages, self._dv_pages,
+                jnp.asarray(bt), jnp.asarray(limits), jnp.asarray(active),
+                n_iters=self.spec_iters, k=k,
+                use_pallas=self.use_pallas, int4_kernel=self._int4_kernel,
+                k_scales=self._k_scales, v_scales=self._v_scales,
+            )
+        if self.kv_quant:
+            (toks_d, prop_d, self._k_pages, self._v_pages,
+             self._dk_pages, self._dv_pages,
+             self._k_scales, self._v_scales) = out
+        else:
+            (toks_d, prop_d, self._k_pages, self._v_pages,
+             self._dk_pages, self._dv_pages) = out
+        # ONE [rb, iters, k+1] fetch per dispatch; every acceptance-rate
+        # read below is host numpy (no per-iteration device round trips —
+        # the tpulint TPU007 hazard this step was designed around)
+        toks = np.asarray(toks_d)
+        prop = np.asarray(prop_d)
+        for i, req in enumerate(running):
+            proposed = accepted = 0
+            for it in range(toks.shape[1]):
+                if req.state != "running":
+                    break  # device drafted past this row's stop; discard
+                p_it = int(prop[i, it])
+                proposed += p_it
+                req.spec_proposed_req += p_it
+                committed = 0
+                for t in toks[i, it]:
+                    if t < 0 or req.state != "running":
+                        break
+                    if committed:
+                        # token 2..n of an iteration is accepted draft
+                        # (committed = agreed prefix + 1 correction);
+                        # counted BEFORE _commit_token so a request that
+                        # finishes mid-commit snapshots a complete tally
+                        # into its GenerationResult
+                        accepted += 1
+                        req.spec_accepted_req += 1
+                    req.seq_len += 1
+                    self._seq_lens[req.row] = req.seq_len
+                    self._commit_token(req, int(t), finished)
+                    committed += 1
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            if proposed:
+                rate = accepted / proposed
+                req.spec_accept_ema = (
+                    rate if req.spec_accept_ema is None
+                    else 0.3 * rate + 0.7 * req.spec_accept_ema
+                )
 
     def _spec_decode_step(self, finished: list[GenerationResult]) -> None:
         """One speculative iteration (serving/spec_decode.py): rows on plain
@@ -1346,6 +1648,9 @@ class Engine:
                 "first_token_t": req.first_token_t,
                 "done_t": done_t,
             },
+            spec_proposed=req.spec_proposed_req,
+            spec_accepted=req.spec_accepted_req,
+            spec_fallback=req.spec_fallback,
         )
 
     # --------------------------------------------------------- convenience --
@@ -1456,6 +1761,51 @@ class Engine:
                 if width >= self.max_seq_len:
                     break
                 width *= 2
+        if self._draft_enabled:
+            # the plain-decode FALLBACK must be warm before it's ever
+            # needed: an acceptance collapse mid-request must not pay a
+            # decode_burst compile on top of the throughput it is already
+            # losing (the greedy waves above all routed through the spec
+            # path, so the no-filter burst variant is still cold)
+            wave += 1
+            tok = 2 + wave % max(2, self.cfg.vocab_size - 2)
+            self._force_plain = True
+            try:
+                self.generate([[tok] * 3], sp)
+            finally:
+                self._force_plain = False
+            # compile the whole (k rung x row bucket) spec-burst ladder the
+            # adaptive controller can reach.  All-False ``active`` masks
+            # every KV write and commit, so each call is a pure
+            # shape-compile pass over the live pools (donated -> rebind).
+            from githubrepostorag_tpu.serving.draft_spec import draft_spec_burst
+
+            h = self.max_seq_len
+            for kk in self._spec_k_ladder:
+                for nb in buckets:
+                    out = draft_spec_burst(
+                        self.params, self.draft_params,
+                        self.cfg, self.draft_cfg,
+                        jnp.zeros((nb, h), jnp.int32),
+                        jnp.zeros((nb,), jnp.int32),
+                        jnp.zeros((nb,), jnp.int32),
+                        self._k_pages, self._v_pages,
+                        self._dk_pages, self._dv_pages,
+                        jnp.zeros((nb, self.max_pages_per_seq), jnp.int32),
+                        jnp.zeros((nb,), jnp.int32),
+                        jnp.zeros((nb,), bool),
+                        n_iters=self.spec_iters, k=kk,
+                        use_pallas=self.use_pallas,
+                        int4_kernel=self._int4_kernel,
+                        k_scales=self._k_scales, v_scales=self._v_scales,
+                    )
+                    if self.kv_quant:
+                        (_, _, self._k_pages, self._v_pages,
+                         self._dk_pages, self._dv_pages,
+                         self._k_scales, self._v_scales) = out
+                    else:
+                        (_, _, self._k_pages, self._v_pages,
+                         self._dk_pages, self._dv_pages) = out
         if self.prefix_caching:
             # the cached-prefix presence-marking program ([row bucket,
             # max_seq] — one dispatch per admission wave) only runs on

@@ -121,8 +121,11 @@ class MultiAsyncEngine:
 
     def stats(self) -> dict[str, Any]:
         per = [eng.stats() for eng in self._engines]
-        # union of keys; sum only numeric values (a non-numeric or
-        # replica-local stat stays visible under per_replica)
+        # union of keys; numeric values merge across replicas — counters
+        # SUM, but rate/ratio-style keys would turn into nonsense summed
+        # (two replicas at 0.8 acceptance are not at 1.6), so they merge
+        # by MEAN.  A non-numeric or replica-local stat stays visible
+        # under per_replica.
         keys = sorted(set().union(*(s.keys() for s in per)))
         merged: dict[str, Any] = {}
         for key in keys:
@@ -132,7 +135,10 @@ class MultiAsyncEngine:
                 and not isinstance(s.get(key), bool)
             ]
             if nums:
-                merged[key] = sum(nums)
+                if key.endswith(("_rate", "_ratio", "_utilization")):
+                    merged[key] = sum(nums) / len(nums)
+                else:
+                    merged[key] = sum(nums)
         merged["replicas"] = len(per)
         merged["per_replica"] = per
         return merged

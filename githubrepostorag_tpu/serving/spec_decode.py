@@ -41,15 +41,29 @@ def ngram_propose(
         return []
     window = tokens[-SEARCH_WINDOW:]
     n_tok = len(window)
+    # Every candidate match, for EVERY n-gram length, ends with the newest
+    # token — so index those end positions once instead of rescanning the
+    # whole window per length (the old O(window * max_ngram) list-slice
+    # sweep ran on the host per decode step).  e <= n_tok - 2 keeps at
+    # least one follower token after the match and excludes the suffix's
+    # own trailing token.
+    last = window[-1]
+    ends = [e for e in range(n_tok - 1) if window[e] == last]
+    if not ends:
+        return []
     for n in range(min(max_ngram, n_tok - 1), min_ngram - 1, -1):
-        suffix = window[-n:]
         # EARLIEST occurrence wins (vLLM prompt-lookup order): on repetitive
         # text the most recent match sits just before the suffix itself and
         # truncates the draft to a token or two, while the earliest match
         # has the longest continuation — measured 2.0 vs ~k tokens/dispatch
-        # on a pure repeat run; start <= n_tok - n - 1 means at least one
-        # token always follows the match
-        for start in range(0, n_tok - n):
-            if window[start : start + n] == suffix:
-                return window[start + n : start + n + k]
+        # on a pure repeat run.  For a fixed n, ascending match-END order
+        # is ascending match-START order, so the first hit below is the
+        # same occurrence the old start-ascending scan returned.
+        suffix = window[-n:]
+        for e in ends:
+            s = e - n + 1
+            if s < 0:
+                continue
+            if window[s : e + 1] == suffix:
+                return window[e + 1 : e + 1 + k]
     return []

@@ -160,6 +160,15 @@ def build_table(records: list[dict], driver_name: str,
           "retrieval_conc16_cpu_qps_coalesced"], "q/s"),
         ("Retrieval conc16 coalesced-device speedup (CPU A/B)",
          ["retrieval_conc16_cpu_coalesced_qps_speedup"], "×"),
+        ("Draft-model spec conc8 agg, plain / spec (CPU A/B)",
+         ["spec_conc8_cpu_agg_tok_s_plain",
+          "spec_conc8_cpu_agg_tok_s_spec"], "tok/s"),
+        ("Draft-model spec speedup / acceptance (CPU A/B)",
+         ["spec_conc8_cpu_spec_tok_s_speedup",
+          "spec_conc8_cpu_spec_acceptance"], ""),
+        ("Draft-model spec TTFT p95, plain / spec (CPU A/B)",
+         ["spec_conc8_cpu_ttft_p95_ms_plain",
+          "spec_conc8_cpu_ttft_p95_ms_spec"], "ms"),
         ("Qwen2-MoE 16-expert decode, bs=8 (beyond-reference)",
          ["decode_tok_s_per_chip_qwen2-moe-16e_bs8"], "tok/s"),
         ("Qwen2-MoE 16-expert INT8 decode, bs=8",
@@ -184,9 +193,10 @@ def render(root: pathlib.Path = ROOT, driver_name: str | None = None) -> str:
     # (BENCH_retrieval_cpu.json, written by bench.py's CPU branch) carries
     # metrics a TPU-run BENCH_SUMMARY.json doesn't — appended AFTER the
     # summary records so the committed A/B wins any same-name collision
-    retrieval = root / "BENCH_retrieval_cpu.json"
-    if retrieval.exists():
-        records += json.loads(retrieval.read_text())["records"]
+    for artifact in ("BENCH_retrieval_cpu.json", "BENCH_spec_cpu.json"):
+        path = root / artifact
+        if path.exists():
+            records += json.loads(path.read_text())["records"]
     if driver_name == "":
         name, driver = "", {}
     else:

@@ -1,0 +1,14 @@
+"""Negative fixture for TPU007: ONE batched device->host fetch hoisted
+above the loop; in-loop np calls build host-side index arrays from
+literals (not device fetches)."""
+import numpy as np
+
+
+def commit_decode_step(accepted_d, toks_d, reqs):
+    accepted = np.asarray(accepted_d)  # one [B] transfer for the batch
+    toks = np.asarray(toks_d)
+    out = []
+    for i, req in enumerate(reqs):
+        rows = np.asarray([req], dtype=np.int32)  # host-side construction
+        out.append((int(accepted[i]), int(toks[i]), rows.shape[0]))
+    return out

@@ -1,0 +1,13 @@
+"""Positive fixture for TPU007: the speculative-decode controller reads
+the per-row acceptance array from device INSIDE the commit loop — one
+blocking transfer per request per step."""
+import numpy as np
+
+
+def commit_decode_step(accepted_d, toks_d, reqs):
+    out = []
+    for i, req in enumerate(reqs):
+        accepted = np.asarray(accepted_d)  # fetches the whole batch per row
+        toks = np.array(toks_d)
+        out.append((req, int(accepted[i]), int(toks[i])))
+    return out

@@ -1,0 +1,279 @@
+"""Draft-model speculative decoding (serving/draft_spec.py + engine
+controller): the default serving path must be greedy-token-IDENTICAL to
+plain decode for every workload — speculation changes scheduling, never
+tokens — while the adaptive controller (EMA acceptance, deadline margin)
+falls back to plain bursts instead of losing throughput, and live traffic
+never pays an XLA compile the warmup ladder didn't predict.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+from githubrepostorag_tpu.serving import Engine, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """Target and an independently-initialized draft: same vocab, different
+    weights — the draft disagrees often, exercising partial accepts."""
+    cfg = Qwen2Config.tiny()
+    target = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    draft = init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    return cfg, target, draft
+
+
+def _engine(params, cfg, **kw):
+    defaults = dict(max_num_seqs=2, num_pages=32, page_size=4, max_seq_len=64,
+                    kv_dtype=jnp.float32, decode_burst=4)
+    defaults.update(kw)
+    return Engine(params, cfg, **defaults)
+
+
+# ------------------------------------------------------------ construction --
+
+
+def test_draft_requires_cfg_and_matching_vocab(pair):
+    cfg, target, draft = pair
+    with pytest.raises(ValueError, match="set together"):
+        _engine(target, cfg, draft_params=draft)
+    import dataclasses
+
+    bad_cfg = dataclasses.replace(cfg, vocab_size=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="vocab"):
+        _engine(target, cfg, draft_params=draft, draft_cfg=bad_cfg)
+    with pytest.raises(ValueError, match="exclusive"):
+        _engine(target, cfg, draft_params=draft, draft_cfg=cfg, spec_ngram_k=4)
+
+
+# ----------------------------------------------------------- token parity --
+
+
+def test_draft_spec_token_identical_perfect_draft(pair):
+    """Draft == target: every proposal accepted, output byte-identical."""
+    cfg, target, _ = pair
+    prompt = list(range(1, 13))
+    sp = SamplingParams(max_tokens=24, temperature=0.0, stop_token_ids=())
+    plain = _engine(target, cfg).generate([prompt], sp)[0].output_tokens
+
+    eng = _engine(target, cfg, draft_params=target, draft_cfg=cfg,
+                  spec_k=4, spec_iters=2)
+    res = eng.generate([prompt], sp)[0]
+    assert res.output_tokens == plain
+    assert eng.spec_proposed > 0
+    # a perfect draft is fully accepted (the last round before max_tokens
+    # may be truncated by the commit loop, so assert near-total)
+    assert eng.spec_accepted / eng.spec_proposed > 0.8
+    assert res.spec_proposed == eng.spec_proposed
+    assert res.spec_accepted == eng.spec_accepted
+    assert res.spec_fallback is None
+
+
+def test_draft_spec_token_identical_disagreeing_draft(pair):
+    """An unrelated draft mispredicts nearly always; the correction token
+    machinery must still reproduce plain greedy output exactly."""
+    cfg, target, draft = pair
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist() for n in (9, 14)]
+    sp = SamplingParams(max_tokens=16, temperature=0.0, stop_token_ids=())
+    plain = _engine(target, cfg).generate(prompts, sp)
+    # floor=0 keeps the controller from falling back mid-run: this test
+    # pins PARITY of the speculative path itself under ~zero acceptance
+    eng = _engine(target, cfg, draft_params=draft, draft_cfg=cfg,
+                  spec_k=2, spec_iters=2, spec_accept_floor=0.0)
+    got = eng.generate(prompts, sp)
+    for a, b in zip(got, plain):
+        assert a.output_tokens == b.output_tokens
+    assert eng.spec_proposed > 0
+
+
+def test_draft_spec_respects_stop_and_page_accounting(pair):
+    """A stop token landing inside an accepted draft run ends the request
+    at the stop; pages all return to the pool."""
+    cfg, target, _ = pair
+    prompt = [3, 4, 5, 6, 7]
+    sp0 = SamplingParams(max_tokens=20, temperature=0.0, stop_token_ids=())
+    ref = _engine(target, cfg).generate([prompt], sp0)[0].output_tokens
+    stop = ref[6]
+    sp = SamplingParams(max_tokens=20, temperature=0.0, stop_token_ids=(stop,))
+    expect = _engine(target, cfg).generate([prompt], sp)[0]
+
+    eng = _engine(target, cfg, draft_params=target, draft_cfg=cfg,
+                  spec_k=4, spec_iters=2)
+    got = eng.generate([prompt], sp)[0]
+    assert got.output_tokens == expect.output_tokens
+    assert got.finish_reason == expect.finish_reason == "stop"
+    assert eng._allocator.free_count == eng._allocator.num_pages
+    assert not eng.has_work()
+
+
+def test_draft_spec_mixed_batch_demotes_then_resumes(pair):
+    """A sampled row in the batch demotes the whole dispatch to plain
+    decode (per-step, not sticky): the greedy row still matches the plain
+    engine, and once the sampled row finishes, speculation resumes."""
+    cfg, target, _ = pair
+    rng = np.random.default_rng(5)
+    prompts = [
+        list(range(2, 12)),
+        rng.integers(0, cfg.vocab_size, 8).tolist(),
+    ]
+    sps = [
+        SamplingParams(max_tokens=24, temperature=0.0, stop_token_ids=()),
+        SamplingParams(max_tokens=4, temperature=0.9, stop_token_ids=()),
+    ]
+    plain = _engine(target, cfg, rng_seed=11).generate(prompts, sps)
+    eng = _engine(target, cfg, rng_seed=11, draft_params=target, draft_cfg=cfg,
+                  spec_k=2, spec_iters=2)
+    got = eng.generate(prompts, sps)
+    assert got[0].output_tokens == plain[0].output_tokens
+    assert len(got[1].output_tokens) == 4
+    # the sampled row finished after 4 tokens; the greedy row's remaining
+    # 20 tokens ran speculatively
+    assert eng.spec_proposed > 0
+    assert got[1].spec_proposed == 0  # sampled rows never propose
+
+
+def test_draft_spec_with_prefix_cache_and_continuous_batching(pair):
+    """Speculation composes with prefix caching + mid-run admission: the
+    draft KV for a shared prefix was written by the prefill ride-along, so
+    a cache-hit request resumes correctly on both pools."""
+    cfg, target, _ = pair
+    p1 = list(range(1, 17))
+    p2 = list(range(1, 17)) + [20, 21]
+    sp = SamplingParams(max_tokens=10, temperature=0.0, stop_token_ids=())
+    plain = _engine(target, cfg)
+    exp1 = plain.generate([p1], sp)[0].output_tokens
+    exp2 = plain.generate([p2], sp)[0].output_tokens
+
+    eng = _engine(target, cfg, draft_params=target, draft_cfg=cfg,
+                  spec_k=2, spec_iters=2, prefix_caching=True)
+    done = {}
+    r1 = eng.add_request(p1, sp)
+    # one step: prefill + the ride-along spec dispatch, then admit p2 so
+    # it prefills (cache hit) while p1 is still decoding
+    for res in eng.step():
+        done[res.request_id] = res
+    r2 = eng.add_request(p2, sp)
+    while eng.has_work():
+        for res in eng.step():
+            done[res.request_id] = res
+    assert done[r1].output_tokens == exp1
+    assert done[r2].output_tokens == exp2
+    assert eng._allocator.hit_tokens > 0
+
+
+# -------------------------------------------------- adaptive controller --
+
+
+def test_acceptance_collapse_falls_back_and_completes():
+    """Chaos: an adversarial draft with GUARANTEED zero acceptance —
+    target narrates the token cycle t -> t+1 (zero layers + rolled
+    lm_head, the bench construction), the draft narrates t -> t+2, so
+    every proposal disagrees.  The EMA collapses below the floor, the
+    controller marks a STICKY per-request fallback, the fallback counter
+    increments, and the request finishes on plain bursts with identical
+    tokens — before its deadline."""
+    import dataclasses
+
+    cfg = dataclasses.replace(Qwen2Config.tiny(), tie_word_embeddings=False)
+    tp = init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    target = dict(tp, layers=jax.tree.map(jnp.zeros_like, tp["layers"]),
+                  lm_head=jnp.roll(tp["embed"], 1, axis=0).T)
+    dp = init_params(cfg, jax.random.PRNGKey(6), dtype=jnp.float32)
+    draft = dict(dp, layers=jax.tree.map(jnp.zeros_like, dp["layers"]),
+                 lm_head=jnp.roll(dp["embed"], 2, axis=0).T)
+
+    prompt = [100, 101, 102]
+    sp = SamplingParams(max_tokens=24, temperature=0.0, stop_token_ids=())
+    plain = _engine(target, cfg).generate([prompt], sp)[0].output_tokens
+    assert plain == list(range(103, 127))  # the narrator narrates
+
+    eng = _engine(target, cfg, draft_params=draft, draft_cfg=cfg,
+                  spec_k=2, spec_iters=2, spec_accept_floor=0.5)
+    deadline = time.monotonic() + 60.0
+    rid = eng.add_request(prompt, sp, deadline_s=deadline)
+    done = {}
+    while eng.has_work():
+        for res in eng.step():
+            done[res.request_id] = res
+    assert time.monotonic() < deadline  # deadline still met
+    assert done[rid].output_tokens == plain
+    assert done[rid].finish_reason == "length"
+    assert done[rid].spec_fallback == "acceptance"
+    assert eng.spec_fallbacks.get("acceptance", 0) >= 1
+
+
+def test_deadline_pressure_falls_back(pair):
+    """A request whose remaining deadline budget is under the margin never
+    enters the spec burst — plain decode's per-burst stop granularity wins
+    near the wire."""
+    cfg, target, _ = pair
+    sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+    eng = _engine(target, cfg, draft_params=target, draft_cfg=cfg,
+                  spec_k=2, spec_iters=2, spec_deadline_margin_s=1e9)
+    rid = eng.add_request(list(range(1, 9)), sp,
+                          deadline_s=time.monotonic() + 60.0)
+    done = {}
+    while eng.has_work():
+        for res in eng.step():
+            done[res.request_id] = res
+    assert done[rid].spec_fallback == "deadline"
+    assert eng.spec_fallbacks.get("deadline", 0) == 1
+    assert eng.spec_proposed == 0  # never speculated
+    assert len(done[rid].output_tokens) == 8
+
+
+def test_pick_spec_k_scales_with_acceptance(pair):
+    cfg, target, draft = pair
+    eng = _engine(target, cfg, draft_params=draft, draft_cfg=cfg, spec_k=4)
+    assert eng._spec_k_ladder == [1, 2, 4]
+
+    class R:  # minimal stand-in: _pick_spec_k only reads spec_accept_ema
+        def __init__(self, ema):
+            self.spec_accept_ema = ema
+
+    assert eng._pick_spec_k([R(None)]) == 4  # no history: optimistic
+    assert eng._pick_spec_k([R(1.0)]) == 4
+    assert eng._pick_spec_k([R(0.5)]) == 2
+    assert eng._pick_spec_k([R(0.05)]) == 1  # floor of 1, never 0
+
+
+# ------------------------------------------------------- compile discipline --
+
+
+def test_zero_recompiles_across_mixed_spec_plain_traffic(pair):
+    """The acceptance criterion from the issue: after warmup, a mixed
+    spec/plain traffic pattern (greedy batches at both buckets, a sampled
+    row demoting a step, adaptive-k downshift) compiles ZERO new XLA
+    programs."""
+    from githubrepostorag_tpu.obs.engine_profile import CompileWatchdog
+
+    cfg, target, draft = pair
+    eng = _engine(target, cfg, draft_params=target, draft_cfg=cfg,
+                  spec_k=2, spec_iters=2)
+    eng.warmup()
+    wd = CompileWatchdog()
+    wd.resync()
+
+    sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+    sampled = SamplingParams(max_tokens=4, temperature=0.8, stop_token_ids=())
+    eng.generate([[1, 2, 3]], sp)                       # bucket 1, spec
+    eng.generate([[4, 5, 6], [7, 8, 9]], sp)            # bucket 2, spec
+    eng.generate([[1, 2, 3], [4, 5, 6]], [sp, sampled])  # mixed -> plain step
+    # drive EMA down with a disagreeing draft on the SAME engine shapes:
+    # k downshifts along the precompiled ladder
+    eng2 = _engine(target, cfg, draft_params=draft, draft_cfg=cfg,
+                   spec_k=2, spec_iters=2, spec_accept_floor=0.0)
+    eng2.warmup()
+    wd2 = CompileWatchdog()
+    wd2.resync()
+    eng2.generate([list(range(10, 18))], sp)
+    assert wd.sample() == 0
+    assert wd2.sample() == 0

@@ -148,3 +148,30 @@ async def test_openai_server_over_replicas(tiny):
     assert out1["choices"][0]["message"]["content"] == \
         out2["choices"][0]["message"]["content"]
     await server.stop()
+
+
+def test_stats_merge_sums_counters_and_means_rates():
+    """Merge-rule regression: counters SUM across replicas, but rate/ratio/
+    utilization-suffixed keys merge by MEAN — two replicas at 0.8
+    acceptance are at 0.8, not 1.6."""
+
+    class Stub:
+        def __init__(self, s):
+            self._s = s
+
+        def stats(self):
+            return self._s
+
+    multi = MultiAsyncEngine.__new__(MultiAsyncEngine)
+    multi._engines = [
+        Stub({"requests_admitted": 3, "spec_acceptance_rate": 0.8,
+              "kv_utilization": 0.5, "spec_fallbacks": 1}),
+        Stub({"requests_admitted": 1, "spec_acceptance_rate": 0.4,
+              "kv_utilization": 0.1, "spec_fallbacks": 0}),
+    ]
+    merged = MultiAsyncEngine.stats(multi)
+    assert merged["requests_admitted"] == 4  # counter: summed
+    assert merged["spec_acceptance_rate"] == pytest.approx(0.6)  # rate: mean
+    assert merged["kv_utilization"] == pytest.approx(0.3)
+    assert merged["spec_fallbacks"] == 1  # plain counter, still summed
+    assert merged["replicas"] == 2

@@ -311,3 +311,78 @@ def test_rag_quoting_construction():
     # and the drafter's acceptance is PARTIAL: well above chance, below 1.0
     acceptance = eng.spec_accepted / max(eng.spec_proposed, 1)
     assert 0.3 < acceptance < 1.0, acceptance
+
+
+# ----------------------------------------------- proposal parity + edges --
+
+
+def _ngram_propose_reference(tokens, k, *, max_ngram=4, min_ngram=1):
+    """The pre-optimization implementation, kept verbatim as the parity
+    oracle: longest n first, earliest start wins, O(window * max_ngram)
+    slice sweep."""
+    from githubrepostorag_tpu.serving.spec_decode import SEARCH_WINDOW
+
+    if k <= 0 or len(tokens) < min_ngram + 1:
+        return []
+    window = tokens[-SEARCH_WINDOW:]
+    n_tok = len(window)
+    for n in range(min(max_ngram, n_tok - 1), min_ngram - 1, -1):
+        suffix = window[-n:]
+        for s in range(n_tok - n):
+            if window[s : s + n] == suffix:
+                return window[s + n : s + n + k]
+    return []
+
+
+def test_ngram_propose_matches_reference_fuzz():
+    """The indexed early-exit rewrite must be decision-identical to the
+    slice-sweep reference on thousands of random cases (small alphabets
+    force repeats; degenerate k/ngram bounds included)."""
+    rng = np.random.default_rng(23)
+    for trial in range(2000):
+        alpha = int(rng.integers(2, 8))
+        n = int(rng.integers(0, 40))
+        toks = rng.integers(0, alpha, n).tolist()
+        k = int(rng.integers(0, 6))
+        max_n = int(rng.integers(1, 6))
+        min_n = int(rng.integers(1, max_n + 1))
+        got = ngram_propose(toks, k, max_ngram=max_n, min_ngram=min_n)
+        want = _ngram_propose_reference(toks, k, max_ngram=max_n, min_ngram=min_n)
+        assert got == want, (toks, k, max_n, min_n, got, want)
+
+
+def test_spec_burst_kv_quant_round_trip_parity(tiny):
+    """Int8 KV through the fused spec burst: the scan carries the scale
+    pools alongside the quantized pages, and output must be token-identical
+    to the PLAIN engine on the same int8 pools — quantization error is
+    shared, scheduling must not add any."""
+    _, params, cfg = tiny
+    prompt = [7, 8, 9, 10] * 8
+    sp = SamplingParams(max_tokens=24, temperature=0.0, stop_token_ids=())
+    plain = _engine(params, cfg, kv_quant=True).generate([prompt], sp)[0]
+    eng = _engine(params, cfg, kv_quant=True, spec_ngram_k=4, spec_burst_iters=3)
+    got = eng.generate([prompt], sp)[0]
+    assert got.output_tokens == plain.output_tokens
+    assert eng.spec_proposed > 0 and eng.spec_accepted > 0
+    assert eng._allocator.free_count == eng._allocator.num_pages
+
+
+def test_spec_burst_draft_overflowing_row_limits(tiny):
+    """A row near its KV budget: ``row_limits`` forces the draft length to
+    clip mid-iteration (dlen = limit - len - 1) so the correction token
+    always has a slot.  The request must end exactly where the plain
+    engine ends, with pages balanced."""
+    _, params, cfg = tiny
+    # max_seq_len=32 -> row limit 31; the 20-token looping prompt leaves
+    # 12 decode slots, so a k=4 draft must clip in the final iterations
+    geom = dict(max_seq_len=32, page_size=4, num_pages=32)
+    prompt = [5, 6, 7, 8] * 5
+    sp = SamplingParams(max_tokens=20, temperature=0.0, stop_token_ids=())
+    plain = _engine(params, cfg, **geom).generate([prompt], sp)[0]
+    eng = _engine(params, cfg, spec_ngram_k=4, spec_burst_iters=4, **geom)
+    got = eng.generate([prompt], sp)[0]
+    assert got.output_tokens == plain.output_tokens
+    assert got.finish_reason == plain.finish_reason == "length"
+    assert eng.spec_accepted > 0  # the loop really drafted near the limit
+    assert eng._allocator.free_count == eng._allocator.num_pages
+    assert not eng.has_work()

@@ -29,7 +29,7 @@ from tools.tpulint.rules import RULES  # noqa: E402
 
 FIXTURES = REPO / "tests" / "lint_fixtures"
 RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-            "ASY001", "ASY002", "OBS001"]
+            "TPU007", "ASY001", "ASY002", "OBS001"]
 
 
 # ------------------------------------------------------------------ registry

@@ -601,6 +601,68 @@ def check_tpu006(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
 
 
 # --------------------------------------------------------------------------
+# TPU007 — per-iteration device->host fetch inside a hot-path loop
+
+_HOST_FETCH_DOTTED = {
+    "np.asarray", "numpy.asarray", "onp.asarray",
+    "np.array", "numpy.array", "onp.array",
+    "jax.device_get",
+}
+# literal/comprehension arguments are host-side constructions (building an
+# int32 index array from request fields), not device-array fetches
+_HOST_LITERAL_ARGS = (
+    ast.Constant, ast.List, ast.Tuple, ast.Set, ast.Dict,
+    ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+)
+
+
+@register(
+    "TPU007",
+    "per-iteration device->host fetch inside a step/decode/prefill loop",
+    "np.asarray / jax.device_get on a device array inside a Python loop "
+    "pays one blocking device->host transfer per iteration — the "
+    "speculative-decode hazard: reading per-row acceptance inside the "
+    "commit loop serializes the device against the driver N times per "
+    "step. Fetch ONCE before the loop (one batched [B, ...] transfer) and "
+    "index the host array.",
+)
+def check_tpu007(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    if ctx.is_test_file:
+        return  # tests fetch per-assert deliberately
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _HOT_NAME_RE.search(fn.name):
+            continue
+        loops = [
+            (n.lineno, n.end_lineno or n.lineno)
+            for n in walk_shallow(fn)
+            if isinstance(n, (ast.For, ast.AsyncFor, ast.While))
+        ]
+        if not loops:
+            continue
+        for node in walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fd = dotted(node.func)
+            if fd not in _HOST_FETCH_DOTTED:
+                continue
+            if node.args and isinstance(node.args[0], _HOST_LITERAL_ARGS):
+                continue
+            # a loop header's own line belongs to the loop body too
+            # (`for t in np.asarray(x):` fetches per outer iteration when
+            # nested) — strictly-inside is line > lo for the owning loop
+            if any(lo < node.lineno <= hi or node.lineno == lo for lo, hi in loops
+                   if lo < node.lineno <= hi):
+                yield (
+                    node.lineno, node.col_offset,
+                    f"{fd}() inside a loop in hot-path '{fn.name}' fetches "
+                    "from device every iteration — hoist ONE batched fetch "
+                    "above the loop and index the host array",
+                )
+
+
+# --------------------------------------------------------------------------
 # ASY001 — blocking calls inside async def
 
 _BLOCKING_CALLS = {
