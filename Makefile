@@ -1,7 +1,7 @@
 # Developer entrypoints (reference: Makefile — env create + per-component
 # pytest; here one package, one suite, plus native build / bench / deploy).
 
-.PHONY: all native test test-fast bench serve lint image deploy clean
+.PHONY: all native test test-fast bench serve lint lint-baseline image deploy clean
 
 all: native test
 
@@ -15,10 +15,19 @@ test-fast:
 	python -m pytest tests/ -q -m "not slow"
 
 # tpulint: in-tree static analysis for JAX trace-safety, host-sync, and
-# async-race hazards (fails on any unsuppressed finding; fixtures under
+# async-race hazards, including the whole-program WPA pass (fails on any
+# unsuppressed finding not in the committed baseline; fixtures under
 # tests/lint_fixtures are the rule corpus, not production code)
 lint:
-	python -m tools.tpulint githubrepostorag_tpu tests --exclude tests/lint_fixtures
+	python -m tools.tpulint githubrepostorag_tpu tests \
+		--exclude tests/lint_fixtures --baseline tools/tpulint/baseline.json
+
+# regenerate the baseline after an intentional change (new rule rollout);
+# the committed baseline is expected to stay empty — prefer a justified
+# `# tpulint: disable=RULE -- why` suppression over baselining debt
+lint-baseline:
+	python -m tools.tpulint githubrepostorag_tpu tests \
+		--exclude tests/lint_fixtures --write-baseline tools/tpulint/baseline.json
 
 bench:
 	python bench.py

@@ -77,6 +77,11 @@ class CompileWatchdog:
 
     def __init__(self, jits: list[tuple[str, Any]] | None = None) -> None:
         self._jits = discover_jits() if jits is None else list(jits)
+        # resync() runs on the event loop (serve start / mark_warm) while
+        # sample() runs on the driver thread every step; _last needs a lock
+        # or a resync racing a sample mis-attributes warmup compiles to
+        # live traffic
+        self._lock = threading.Lock()
         self._last = self.cache_size()
 
     def cache_size(self) -> int:
@@ -91,13 +96,16 @@ class CompileWatchdog:
     def resync(self) -> None:
         """Rebaseline — called at serve start so warmup's own compiles
         (expected, pre-traffic) never count as live-traffic compiles."""
-        self._last = self.cache_size()
+        size = self.cache_size()
+        with self._lock:
+            self._last = size
 
     def sample(self) -> int:
         """New programs compiled since the previous sample (>= 0)."""
         size = self.cache_size()
-        delta = size - self._last
-        self._last = size
+        with self._lock:
+            delta = size - self._last
+            self._last = size
         return max(0, delta)
 
 
@@ -126,7 +134,8 @@ class EngineStepProfiler:
         """Declare warmup finished: compiles observed after this are
         live-traffic compiles."""
         self.watchdog.resync()
-        self._last_step_end = None
+        with self._lock:
+            self._last_step_end = None
 
     # ------------------------------------------------------------- steps --
 
@@ -135,9 +144,11 @@ class EngineStepProfiler:
         Returns the number of fresh compiles observed (for tests)."""
         from githubrepostorag_tpu.metrics import SCHED_STALL, XLA_COMPILES
 
-        if self._last_step_end is not None:
-            SCHED_STALL.set(max(0.0, step_start - self._last_step_end))
-        self._last_step_end = step_end
+        with self._lock:
+            prev = self._last_step_end
+            self._last_step_end = step_end
+        if prev is not None:
+            SCHED_STALL.set(max(0.0, step_start - prev))
 
         delta = self.watchdog.sample()
         if delta > 0:
@@ -156,7 +167,8 @@ class EngineStepProfiler:
 
     def idle(self) -> None:
         """The driver found no work — the next gap is idleness, not stall."""
-        self._last_step_end = None
+        with self._lock:
+            self._last_step_end = None
         from githubrepostorag_tpu.metrics import SCHED_STALL
 
         SCHED_STALL.set(0.0)

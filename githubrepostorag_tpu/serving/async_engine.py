@@ -56,21 +56,29 @@ class AsyncEngine:
     async def start(self) -> None:
         if self._thread is not None:
             return
+        # tpulint: disable=WPA002 -- written before Thread.start() below; the thread launch is the happens-before edge that publishes it to the driver
         self._stop = False  # allow stop() -> start() relaunch
         # rebaseline the compile watchdog: programs compiled before serve
         # start (warmup, imports) are expected — only compiles during live
         # stepping should count
         self.profiler.mark_warm()
+        # tpulint: disable=WPA002 -- written before Thread.start() below; the thread launch is the happens-before edge that publishes it to the driver
         self._loop = asyncio.get_running_loop()
         self._thread = threading.Thread(target=self._drive, name="engine-driver", daemon=True)
         self._thread.start()
 
     async def stop(self) -> None:
+        # tpulint: disable=WPA002 -- GIL-atomic bool store signaling the driver loop; it re-checks every iteration and _wake.set() bounds the latency, while a lock here would serialize stop() against a multi-second step
         self._stop = True
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            # the driver may be mid-step (a cold compile holds it for
+            # seconds); joining inline would freeze every coroutine in the
+            # process for up to the timeout — wait off-loop instead
+            await asyncio.get_running_loop().run_in_executor(
+                None, thread.join, 10
+            )
 
     def _drive(self) -> None:
         from githubrepostorag_tpu.metrics import (

@@ -91,6 +91,7 @@ class MultiAsyncEngine:
         prompt_ids: list[int],
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> AsyncIterator[StreamEvent]:
         # engines generate per-engine "req-N" ids that would collide across
         # replicas; mint a process-unique id when the caller didn't
@@ -98,7 +99,9 @@ class MultiAsyncEngine:
         target = self._pick()
         self._route[rid] = target
         try:
-            async for event in target.stream(prompt_ids, sampling, request_id=rid):
+            async for event in target.stream(
+                prompt_ids, sampling, request_id=rid, deadline_s=deadline_s
+            ):
                 yield event
         finally:
             self._route.pop(rid, None)
@@ -108,8 +111,10 @@ class MultiAsyncEngine:
         prompt_ids: list[int],
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> GenerationResult:
-        async for event in self.stream(prompt_ids, sampling, request_id):
+        async for event in self.stream(prompt_ids, sampling, request_id,
+                                       deadline_s=deadline_s):
             if event.type == "final":
                 return event.result
         raise RuntimeError("stream ended without a final event")  # pragma: no cover

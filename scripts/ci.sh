@@ -7,6 +7,15 @@ cd "$(dirname "$0")/.."
 echo "== tpulint =="
 make lint
 
+echo "== tpulint whole-program JSON artifact =="
+# machine-readable findings (incl. suppressed + baselined) for CI consumers;
+# the baseline gate itself already ran inside `make lint`
+mkdir -p artifacts
+python -m tools.tpulint githubrepostorag_tpu tests \
+    --exclude tests/lint_fixtures --baseline tools/tpulint/baseline.json \
+    --format json > artifacts/tpulint.json \
+    || { echo "tpulint JSON pass failed (exit $?)"; exit 1; }
+
 echo "== /debug/traces schema =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_traces_schema.py
 

@@ -1,0 +1,2 @@
+"""WPA001 negative: the same blocking helper, but only ever reached
+through run_in_executor — it runs in the pool, not on the loop."""

@@ -1,0 +1,9 @@
+import asyncio
+
+from wpa001_neg.io_helpers import refresh_cache
+
+
+async def handle_request(request):
+    loop = asyncio.get_running_loop()
+    data = await loop.run_in_executor(None, refresh_cache)
+    return data

@@ -1,0 +1,6 @@
+import time
+
+
+def refresh_cache():
+    time.sleep(0.5)
+    return {}

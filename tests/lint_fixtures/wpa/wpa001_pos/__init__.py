@@ -1,0 +1,2 @@
+"""WPA001 positive: a sync helper two modules away from the async def
+blocks — only the whole-program pass can see it."""

@@ -1,0 +1,7 @@
+from wpa001_pos.io_helpers import refresh_cache
+
+
+async def handle_request(request):
+    # direct call from a coroutine: refresh_cache inherits event_loop
+    data = refresh_cache()
+    return data

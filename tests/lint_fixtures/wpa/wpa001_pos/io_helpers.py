@@ -1,0 +1,7 @@
+import time
+
+
+def refresh_cache():
+    # blocking primitive in a sync function; harmless in isolation
+    time.sleep(0.5)
+    return {}

@@ -1,0 +1,2 @@
+"""WPA001 suppressed: same shape as the positive, silenced with a
+justified directive at the blocking call site."""

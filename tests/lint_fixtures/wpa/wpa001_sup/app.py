@@ -1,0 +1,6 @@
+from wpa001_sup.io_helpers import refresh_cache
+
+
+async def handle_request(request):
+    data = refresh_cache()
+    return data

@@ -1,0 +1,2 @@
+"""WPA002 negative: the same cross-domain access pattern, but both sites
+acquire the same lock."""

@@ -1,0 +1,17 @@
+import threading
+
+
+class Service:
+    def __init__(self):
+        self.status = "idle"
+        self._lock = threading.Lock()
+
+    async def update(self):
+        with self._lock:
+            self.status = "busy"
+
+    def _run(self):
+        while True:
+            with self._lock:
+                if self.status == "busy":
+                    return

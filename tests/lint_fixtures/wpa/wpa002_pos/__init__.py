@@ -1,0 +1,3 @@
+"""WPA002 positive: status attribute written on the event loop, read on
+the driver thread, no common lock.  The Thread spawn lives in a second
+module — the domain seed is cross-module."""

@@ -1,0 +1,11 @@
+class Service:
+    def __init__(self):
+        self.status = "idle"
+
+    async def update(self):
+        self.status = "busy"
+
+    def _run(self):
+        while True:
+            if self.status == "busy":
+                return

@@ -1,0 +1,2 @@
+"""WPA002 suppressed: lock-free flag write silenced with a justification
+(the GIL-atomic-bool-signal idiom)."""

@@ -1,0 +1,7 @@
+import threading
+
+from wpa002_sup.service import Service
+
+
+def launch(svc: Service):
+    threading.Thread(target=svc._run, daemon=True).start()

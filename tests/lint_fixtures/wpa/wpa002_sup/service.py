@@ -1,0 +1,12 @@
+class Service:
+    def __init__(self):
+        self.status = "idle"
+
+    async def update(self):
+        # tpulint: disable=WPA002 -- GIL-atomic string store; the driver polls it and tolerates one stale iteration
+        self.status = "busy"
+
+    def _run(self):
+        while True:
+            if self.status == "busy":
+                return

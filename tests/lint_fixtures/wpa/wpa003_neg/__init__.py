@@ -1,0 +1,2 @@
+"""WPA003 negative: awaiting under an asyncio.Lock (async with) is the
+intended pattern — only sync locks held across awaits are flagged."""

@@ -1,0 +1,13 @@
+import asyncio
+
+from wpa003_neg.sink import Sink
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self.sink = Sink()
+
+    async def flush(self, batch):
+        async with self._lock:
+            await self.sink.send(batch)

@@ -1,0 +1,2 @@
+"""WPA003 positive: a threading.Lock held across an await — the driver
+thread contending for the same lock deadlocks against the loop."""

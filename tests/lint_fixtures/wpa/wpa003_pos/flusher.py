@@ -1,0 +1,13 @@
+import threading
+
+from wpa003_pos.sink import Sink
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sink = Sink()
+
+    async def flush(self, batch):
+        with self._lock:
+            await self.sink.send(batch)

@@ -1,0 +1,2 @@
+"""WPA003 suppressed: sync lock across an await, silenced with a
+justification at the await site."""

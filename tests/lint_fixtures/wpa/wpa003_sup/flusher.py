@@ -1,0 +1,14 @@
+import threading
+
+from wpa003_sup.sink import Sink
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sink = Sink()
+
+    async def flush(self, batch):
+        with self._lock:
+            # tpulint: disable=WPA003 -- single-writer lock; no other domain ever acquires it (profiling-only build)
+            await self.sink.send(batch)

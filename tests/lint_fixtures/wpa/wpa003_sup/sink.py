@@ -1,0 +1,3 @@
+class Sink:
+    async def send(self, batch):
+        return len(batch)

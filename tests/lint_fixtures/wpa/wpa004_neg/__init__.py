@@ -1,0 +1,2 @@
+"""WPA004 negative: the alloc-absorb-commit-release shape done right —
+every path from allocate reaches exactly one release."""

@@ -1,0 +1,19 @@
+from wpa004_neg.pool import OutOfPages, PagePool
+
+
+class Cache:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def reserve(self, req, n):
+        shared = self.pool.share(req.key)
+        try:
+            pages = shared + self.pool.allocate(n - len(shared))
+        except OutOfPages:
+            self.pool.release(shared)
+            return None
+        req.pages = pages
+        return req
+
+    def teardown(self, req):
+        self.pool.release(req.pages)

@@ -1,0 +1,2 @@
+"""WPA004 positive: a page handle leaked by an early return and a
+double-free — the allocate/release pairing broken both ways."""

@@ -1,0 +1,21 @@
+from wpa004_pos.pool import PagePool
+
+
+class Cache:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def reserve(self, req, n):
+        pages = self.pool.allocate(n)
+        if n > 4:
+            return None  # drops the owned handle: leak
+        req.pages = pages
+        return req
+
+    def drop_one(self):
+        pages = self.pool.allocate(1)
+        self.pool.release(pages)
+        self.pool.release(pages)  # double free
+
+    def teardown(self, req):
+        self.pool.release(req.pages)

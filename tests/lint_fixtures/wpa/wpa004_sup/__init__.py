@@ -1,0 +1,2 @@
+"""WPA004 suppressed: the early-return leak silenced with a justified
+directive at the return site."""

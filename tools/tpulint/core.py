@@ -1,4 +1,5 @@
-"""Driver: file discovery, per-file rule pipeline, suppression comments.
+"""Driver: file discovery, per-file rule pipeline, whole-program pass,
+suppression comments, baseline fingerprints.
 
 Suppression grammar (one comment, same line as the finding or alone on the
 line above it):
@@ -8,26 +9,42 @@ line above it):
 
 The justification is mandatory: a bare ``disable=RULE`` is itself reported
 (LNT000) so silenced findings stay auditable.  Unknown rule ids in a
-directive are reported as LNT001.  Files that fail to parse are reported as
-LNT100 rather than crashing the run.
+directive are reported as LNT001 (and get their own CLI exit code, 3 — a
+misspelled id would otherwise silently stop suppressing).  A justified
+directive that matches zero findings is reported as LNT002 by ``run_paths``
+so dead suppressions get cleaned up instead of hiding future findings.
+Files that fail to parse are reported as LNT100 rather than crashing.
+
+``run_paths`` additionally runs the whole-program pass (program.py): the
+per-file findings and the cross-module WPA findings merge *before*
+suppressions apply, so one grammar silences both kinds.  Test files
+(``test_*`` / ``conftest*``) contribute nothing to the program graph —
+test coroutines calling production helpers must not leak test-only
+execution domains into the graph.
 """
 
 from __future__ import annotations
 
 import ast
 import io
+import os
+import json
 import re
 import tokenize
 from dataclasses import dataclass, field
-from pathlib import Path
+from pathlib import Path, PurePosixPath
 from typing import Iterable, Iterator
 
 from tools.tpulint.rules import RULES, FileContext
+from tools.tpulint.program import analyze_program
 
 # meta-rule ids (not suppressible findings about findings)
 RULE_NO_JUSTIFICATION = "LNT000"
 RULE_UNKNOWN_RULE = "LNT001"
+RULE_STALE_SUPPRESSION = "LNT002"
 RULE_PARSE_ERROR = "LNT100"
+
+BASELINE_VERSION = 1
 
 _DIRECTIVE_RE = re.compile(
     r"#\s*tpulint:\s*disable=(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
@@ -45,9 +62,17 @@ class Finding:
     message: str
     suppressed: bool = False
     justification: str | None = None
+    qualname: str | None = None
+    baselined: bool = False
 
     def location(self) -> str:
         return f"{self.path}:{self.line}:{self.col}"
+
+    def fingerprint(self) -> str:
+        """Line-insensitive identity for baseline mode: a finding keeps its
+        fingerprint when code above it moves, and loses it when the
+        enclosing function is renamed (which deserves a fresh look)."""
+        return f"{self.rule}::{_fingerprint_path(self.path)}::{self.qualname or '<module>'}"
 
 
 @dataclass
@@ -57,6 +82,17 @@ class Suppression:
     rules: tuple[str, ...]
     justification: str
     used: bool = field(default=False)
+    has_unknown_rule: bool = field(default=False)
+
+
+def _fingerprint_path(path: str) -> str:
+    p = PurePosixPath(path.replace("\\", "/"))
+    if p.is_absolute():
+        try:
+            p = p.relative_to(PurePosixPath(os.getcwd().replace("\\", "/")))
+        except ValueError:
+            pass
+    return str(p)
 
 
 def _parse_suppressions(source: str, path: str) -> tuple[list[Suppression], list[Finding]]:
@@ -87,11 +123,14 @@ def _parse_suppressions(source: str, path: str) -> tuple[list[Suppression], list
                 if stripped and not stripped.startswith("#"):
                     break
                 target += 1
+        has_unknown = False
         for rule_id in rules:
             if rule_id not in RULES:
+                has_unknown = True
                 meta.append(Finding(
                     path, line, tok.start[1], RULE_UNKNOWN_RULE,
-                    f"suppression names unknown rule {rule_id!r}",
+                    f"suppression names unknown rule {rule_id!r} — the "
+                    f"directive silences nothing (misspelled id?)",
                 ))
         if not justification:
             meta.append(Finding(
@@ -99,24 +138,43 @@ def _parse_suppressions(source: str, path: str) -> tuple[list[Suppression], list
                 "suppression is missing a justification "
                 "(write `# tpulint: disable=RULE -- why this is safe`)",
             ))
-        suppressions.append(Suppression(line, target, rules, justification))
+        suppressions.append(Suppression(line, target, rules, justification,
+                                        has_unknown_rule=has_unknown))
     return suppressions, meta
 
 
-def analyze_source(source: str, path: str) -> list[Finding]:
-    """Run every rule over one file's source; apply suppressions."""
+@dataclass
+class _FileAnalysis:
+    path: str
+    source: str
+    tree: ast.Module | None
+    findings: list[Finding]
+    suppressions: list[Suppression]
+    meta: list[Finding]
+    is_test_file: bool
+
+
+def _collect_file(source: str, path: str) -> _FileAnalysis:
+    """Per-file rules + suppression directives, *without* applying them."""
+    base = path.replace("\\", "/").rsplit("/", 1)[-1]
+    is_test = base.startswith(("test_", "conftest"))
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
-        return [Finding(path, exc.lineno or 1, exc.offset or 0, RULE_PARSE_ERROR,
-                        f"file does not parse: {exc.msg}")]
+        finding = Finding(path, exc.lineno or 1, exc.offset or 0, RULE_PARSE_ERROR,
+                          f"file does not parse: {exc.msg}")
+        return _FileAnalysis(path, source, None, [finding], [], [], is_test)
     ctx = FileContext(path=path, source=source, tree=tree)
     findings: list[Finding] = []
     for rule in RULES.values():
         for line, col, message in rule.check(ctx):
             findings.append(Finding(path, line, col, rule.id, message))
-
     suppressions, meta = _parse_suppressions(source, path)
+    return _FileAnalysis(path, source, tree, findings, suppressions, meta, is_test)
+
+
+def _apply_suppressions(findings: list[Finding],
+                        suppressions: list[Suppression]) -> None:
     by_line: dict[int, list[Suppression]] = {}
     for sup in suppressions:
         by_line.setdefault(sup.target_line, []).append(sup)
@@ -126,7 +184,51 @@ def analyze_source(source: str, path: str) -> list[Finding]:
                 f.suppressed = True
                 f.justification = sup.justification
                 sup.used = True
-    findings.extend(meta)
+
+
+def _qualname_spans(tree: ast.Module) -> list[tuple[int, int, str]]:
+    spans: list[tuple[int, int, str]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                start = child.lineno
+                if child.decorator_list:
+                    start = min(start, min(d.lineno for d in child.decorator_list))
+                spans.append((start, child.end_lineno or child.lineno, qual))
+                visit(child, qual)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return spans
+
+
+def _assign_qualnames(findings: list[Finding], tree: ast.Module | None) -> None:
+    if tree is None:
+        return
+    spans = _qualname_spans(tree)
+    for f in findings:
+        best: tuple[int, str] | None = None
+        for start, end, qual in spans:
+            if start <= f.line <= end and (best is None or start >= best[0]):
+                best = (start, qual)
+        f.qualname = best[1] if best else "<module>"
+
+
+def analyze_source(source: str, path: str) -> list[Finding]:
+    """Run the per-file rules over one file's source; apply suppressions.
+
+    The whole-program pass and the stale-suppression sweep need the full
+    file set and only run under ``run_paths``.
+    """
+    fa = _collect_file(source, path)
+    if fa.tree is None:
+        return fa.findings
+    _apply_suppressions(fa.findings, fa.suppressions)
+    findings = fa.findings + fa.meta
+    _assign_qualnames(findings, fa.tree)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 
@@ -160,18 +262,80 @@ def iter_py_files(paths: Iterable[str | Path], excludes: Iterable[str] = ()) -> 
             yield p
 
 
-def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = ()) -> tuple[list[Finding], dict]:
-    """Analyze every .py under ``paths`` -> (findings, stats)."""
-    findings: list[Finding] = []
-    n_files = 0
+def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = (),
+              *, program: bool = True) -> tuple[list[Finding], dict]:
+    """Analyze every .py under ``paths`` -> (findings, stats).
+
+    Runs the per-file rules AND the whole-program pass, merges both finding
+    streams per file, applies suppressions over the merged stream, then
+    sweeps for stale (zero-match) suppressions.
+    """
+    analyses: list[_FileAnalysis] = []
     for p in iter_py_files(paths, excludes):
-        n_files += 1
-        findings.extend(analyze_file(p))
+        source = p.read_text(encoding="utf-8", errors="replace")
+        analyses.append(_collect_file(source, str(p)))
+
+    if program:
+        prog_files = [(fa.path, fa.tree, fa.source) for fa in analyses
+                      if fa.tree is not None and not fa.is_test_file]
+        prog_by_path: dict[str, list] = {}
+        for pf in analyze_program(prog_files):
+            prog_by_path.setdefault(pf.path, []).append(pf)
+        for fa in analyses:
+            for pf in prog_by_path.get(fa.path.replace("\\", "/"), ()):
+                fa.findings.append(Finding(fa.path, pf.line, pf.col,
+                                           pf.rule, pf.message))
+
+    findings: list[Finding] = []
+    for fa in analyses:
+        _apply_suppressions(fa.findings, fa.suppressions)
+        for sup in fa.suppressions:
+            if (sup.justification and not sup.used
+                    and not sup.has_unknown_rule):
+                fa.meta.append(Finding(
+                    fa.path, sup.directive_line, 0, RULE_STALE_SUPPRESSION,
+                    f"suppression for {','.join(sup.rules)} matched no "
+                    f"finding — delete it (it would silently swallow the "
+                    f"next real finding on that line)",
+                ))
+        file_findings = fa.findings + fa.meta
+        _assign_qualnames(file_findings, fa.tree)
+        file_findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        findings.extend(file_findings)
+
     unsuppressed = sum(1 for f in findings if not f.suppressed)
     stats = {
-        "files": n_files,
+        "files": len(analyses),
         "findings": len(findings),
         "unsuppressed": unsuppressed,
         "suppressed": len(findings) - unsuppressed,
+        "baselined": 0,
     }
     return findings, stats
+
+
+# --------------------------------------------------------------------------
+# baseline fingerprints: CI fails only on NEW findings
+
+def load_baseline(path: Path) -> set[str]:
+    payload = json.loads(path.read_text(encoding="utf-8"))
+    if payload.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version {payload.get('version')!r}")
+    return set(payload.get("fingerprints", []))
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    fingerprints = sorted({f.fingerprint() for f in findings if not f.suppressed})
+    payload = {"version": BASELINE_VERSION, "fingerprints": fingerprints}
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def apply_baseline(findings: list[Finding], baseline: set[str],
+                   stats: dict) -> None:
+    """Mark known (baselined) findings; they no longer fail the run."""
+    n = 0
+    for f in findings:
+        if not f.suppressed and f.fingerprint() in baseline:
+            f.baselined = True
+            n += 1
+    stats["baselined"] = n

@@ -1,0 +1,1211 @@
+"""Whole-program analysis: cross-module call graph + execution-domain
+inference + the WPA rule family.
+
+The per-file rules (rules.py) see one function at a time; the bugs that
+actually bit this codebase cross module boundaries — a blocking call three
+frames below an ``async def``, an attribute written by the engine driver
+thread and read on the event loop, a KV page allocated in one method and
+leaked by an early return in another.  This pass builds a call graph over
+every module handed to ``run_paths``:
+
+* imports are resolved **in-repo only** (stdlib/third-party calls become
+  leaf primitives, never edges),
+* methods are bound via class-attribute lookup (``self._allocator = A()``
+  in ``__init__`` makes ``self._allocator.allocate()`` an edge to
+  ``A.allocate``),
+* ``run_in_executor`` / ``Thread(target=...)`` / ``asyncio.create_task`` /
+  ``run_coroutine_threadsafe`` / ``call_soon_threadsafe`` are modeled as
+  *domain transitions*, not ordinary calls.
+
+On top of the graph, an execution-domain inference classifies every
+function into a subset of {``event_loop``, ``driver_thread``,
+``executor``} from seeds (``async def`` bodies run on a loop; a
+``Thread(target=f)`` runs ``f`` on a dedicated thread; an executor target
+runs in the pool) and propagates caller domains along ordinary call edges
+to a fixpoint.  A function may legitimately hold several domains — e.g. a
+stats helper called from both the driver loop and an HTTP handler.
+
+Intended domains can be pinned with an annotation comment on (or directly
+above) the ``def`` line::
+
+    # tpulint: domain=driver_thread
+    def _drive(self): ...
+
+``domain=any`` seeds all three (a deliberately thread-safe utility).
+
+Rules:
+
+* **WPA001** — blocking primitive (``time.sleep``, sync sockets, bridge
+  ``Future.result()``, ``Thread.join``, un-awaited ``Event.wait``)
+  executed by a function whose inferred domains include ``event_loop``.
+  This is the transitive closure of ASY001: the primitive may live in a
+  sync helper nested arbitrarily deep below the ``async def``.
+* **WPA002** — attribute of a shared object written in one domain and
+  read in another with no common lock in the acquired-lock-sets at both
+  sites (the ASY002 race shape, cross-module and cross-thread).
+* **WPA003** — lock held across an ``await`` or across a blocking
+  domain-transition wait (``run_coroutine_threadsafe(...).result()``,
+  ``thread.join()``) — the classic loop/driver deadlock shape.
+* **WPA004** — KV-page typestate: for classes that look like page pools
+  (both ``allocate`` and ``release`` methods), prove every path from an
+  ``allocate``/``share`` reaches exactly one commit/``release`` — flag
+  leaks via early return/raise between alloc and commit, double-frees,
+  and committed page attributes that no release path ever reads back.
+
+Everything here is stdlib-``ast`` only and runs in one pass over already
+parsed trees, so ``make lint`` stays fast.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from tools.tpulint.rules import RULES, Rule, _BLOCKING_CALLS, _is_lockish, dotted
+
+# --------------------------------------------------------------------------
+# domains
+
+DOMAIN_EVENT_LOOP = "event_loop"
+DOMAIN_DRIVER = "driver_thread"
+DOMAIN_EXECUTOR = "executor"
+ALL_DOMAINS = (DOMAIN_EVENT_LOOP, DOMAIN_DRIVER, DOMAIN_EXECUTOR)
+
+_DOMAIN_DIRECTIVE_RE = re.compile(r"#\s*tpulint:\s*domain=(\w+)")
+
+AnyFunc = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+# --------------------------------------------------------------------------
+# program model
+
+@dataclass
+class FuncInfo:
+    qualname: str                       # module-dotted, e.g. pkg.mod.Cls.meth
+    module: "ModuleInfo"
+    node: AnyFunc | ast.Lambda
+    cls: "ClassInfo | None" = None
+    is_async: bool = False
+    local_defs: dict[str, "FuncInfo"] = field(default_factory=dict)
+    local_types: dict[str, set[str]] = field(default_factory=dict)  # var -> class qualnames
+    cfutures: set[str] = field(default_factory=set)  # vars holding concurrent futures
+    domains: set[str] = field(default_factory=set)
+    # domain -> human-readable provenance ("async def", "Thread target in f", ...)
+    witness: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+@dataclass
+class ClassInfo:
+    qualname: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    base_quals: list[str] = field(default_factory=list)
+    methods: dict[str, FuncInfo] = field(default_factory=dict)
+    attr_types: dict[str, set[str]] = field(default_factory=dict)  # self.X -> class qualnames
+
+
+@dataclass
+class ModuleInfo:
+    modname: str
+    path: str                            # display path used in findings
+    tree: ast.Module
+    source_lines: list[str]
+    alias: dict[str, str] = field(default_factory=dict)     # local name -> qualified target
+    functions: dict[str, FuncInfo] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+
+    @property
+    def is_test_file(self) -> bool:
+        base = self.path.replace("\\", "/").rsplit("/", 1)[-1]
+        return base.startswith(("test_", "conftest"))
+
+
+@dataclass
+class Edge:
+    caller: FuncInfo
+    callee: FuncInfo
+    transition: str | None               # None = ordinary call, else target domain
+    line: int
+
+
+@dataclass
+class ProgramFinding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+
+# --------------------------------------------------------------------------
+# module name resolution
+
+def module_name_for(path_parts: tuple[str, ...], have_init: "dict[tuple[str, ...], bool]") -> str:
+    """Dotted module name for a file, walking up while __init__.py exists.
+
+    ``path_parts`` is the file path split on '/', without the '.py' suffix
+    on the last part.  ``have_init`` says whether a directory (as a parts
+    tuple) contains an __init__.py.  A file outside any package is a
+    standalone module named by its stem.
+    """
+    *dirs, stem = path_parts
+    start = len(dirs)
+    while start > 0 and have_init.get(tuple(dirs[:start]), False):
+        start -= 1
+    parts = list(dirs[start:]) + [stem]
+    if parts[-1] == "__init__":
+        parts = parts[:-1] or [stem]
+    return ".".join(parts)
+
+
+def _collect_aliases(tree: ast.Module, modname: str) -> dict[str, str]:
+    alias: dict[str, str] = {}
+    pkg_parts = modname.split(".")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    alias[a.asname] = a.name
+                else:
+                    # `import a.b.c` binds `a`; dotted lookups expand through it
+                    alias[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(base_parts + ([node.module] if node.module else []))
+            else:
+                base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                alias[a.asname or a.name] = f"{base}.{a.name}" if base else a.name
+    return alias
+
+
+def _annotation_classes(ann: ast.AST | None, module: ModuleInfo,
+                        program: "Program") -> set[str]:
+    """Class qualnames named by an annotation (unwraps Optional/| unions)."""
+    out: set[str] = set()
+    if ann is None:
+        return out
+    stack = [ann]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+            stack.extend([node.left, node.right])
+        elif isinstance(node, ast.Subscript):
+            stack.append(node.slice)
+            stack.append(node.value)
+        elif isinstance(node, ast.Tuple):
+            stack.extend(node.elts)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            try:
+                stack.append(ast.parse(node.value, mode="eval").body)
+            except SyntaxError:
+                pass
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            cls = program.resolve_class(node, module)
+            if cls is not None:
+                out.add(cls.qualname)
+    return out
+
+
+class Program:
+    """The cross-module call graph and everything derived from it."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: list[FuncInfo] = []          # every FuncInfo incl. nested/lambdas
+        self.classes: dict[str, ClassInfo] = {}      # by qualname
+        self.edges: list[Edge] = []
+        self._edges_by_caller: dict[int, list[Edge]] = {}
+        self._callers_of: dict[int, list[Edge]] = {}
+
+    # ---------------------------------------------------------------- build
+
+    @classmethod
+    def build(cls, files: list[tuple[str, ast.Module, str]]) -> "Program":
+        """``files`` is [(display_path, parsed tree, source)]."""
+        prog = cls()
+        norm = [(p.replace("\\", "/"), tree, src) for p, tree, src in files]
+        have_init = {}
+        for p, _, _ in norm:
+            parts = tuple(p[:-3].split("/"))
+            if parts[-1] == "__init__":
+                have_init[parts[:-1]] = True
+        for p, tree, src in sorted(norm, key=lambda t: t[0]):
+            parts = tuple(p[:-3].split("/"))
+            modname = module_name_for(parts, have_init)
+            mod = ModuleInfo(modname=modname, path=p, tree=tree,
+                             source_lines=src.splitlines())
+            mod.alias = _collect_aliases(tree, modname)
+            prog.modules[modname] = mod
+        for mod in prog.modules.values():
+            prog._index_module(mod)
+        for mod in prog.modules.values():
+            prog._infer_attr_types(mod)
+        for fn in list(prog.functions):
+            prog._build_edges(fn)
+        for edge in prog.edges:
+            prog._edges_by_caller.setdefault(id(edge.caller), []).append(edge)
+            prog._callers_of.setdefault(id(edge.callee), []).append(edge)
+        prog._propagate_domains()
+        return prog
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        def index_func(node: AnyFunc, qual: str, cls: ClassInfo | None) -> FuncInfo:
+            fi = FuncInfo(qualname=qual, module=mod, node=node, cls=cls,
+                          is_async=isinstance(node, ast.AsyncFunctionDef))
+            self.functions.append(fi)
+            for child in ast.iter_child_nodes(node):
+                fi.local_defs.update(index_body(child, qual, None))
+            return fi
+
+        def index_body(node: ast.AST, prefix: str, cls: ClassInfo | None) -> dict[str, FuncInfo]:
+            out: dict[str, FuncInfo] = {}
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[node.name] = index_func(node, f"{prefix}.{node.name}", cls)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(qualname=f"{prefix}.{node.name}", module=mod, node=node)
+                for base in node.bases:
+                    ci.base_quals.append(dotted(base) or "")
+                self.classes[ci.qualname] = ci
+                mod.classes.setdefault(node.name, ci)
+                for child in node.body:
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        ci.methods[child.name] = index_func(
+                            child, f"{ci.qualname}.{child.name}", ci)
+            elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                # defs behind `if TYPE_CHECKING:` / try-import guards still count
+                for child in ast.iter_child_nodes(node):
+                    out.update(index_body(child, prefix, cls))
+            return out
+
+        for top in mod.tree.body:
+            found = index_body(top, mod.modname, None)
+            mod.functions.update(found)
+
+    # ---------------------------------------------------------- resolution
+
+    def resolve_qualified(self, qual: str) -> "FuncInfo | ClassInfo | None":
+        if qual in self.classes:
+            return self.classes[qual]
+        parts = qual.split(".")
+        # longest module prefix wins
+        for cut in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:cut])
+            mod = self.modules.get(modname)
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                return mod.functions.get(rest[0]) or mod.classes.get(rest[0])
+            if len(rest) == 2 and rest[0] in mod.classes:
+                return self.lookup_method(mod.classes[rest[0]], rest[1])
+            return None
+        return None
+
+    def resolve_class(self, expr: ast.AST, mod: ModuleInfo) -> ClassInfo | None:
+        d = dotted(expr)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        if not rest and head in mod.classes:
+            return mod.classes[head]
+        if head in mod.alias:
+            target = self.resolve_qualified(mod.alias[head] + ("." + rest if rest else ""))
+            if isinstance(target, ClassInfo):
+                return target
+        target = self.resolve_qualified(d)
+        return target if isinstance(target, ClassInfo) else None
+
+    def lookup_method(self, cls: ClassInfo, name: str,
+                      _seen: frozenset = frozenset()) -> FuncInfo | None:
+        if cls.qualname in _seen:
+            return None
+        if name in cls.methods:
+            return cls.methods[name]
+        for base in cls.base_quals:
+            base_cls = self.resolve_class_by_name(base, cls.module)
+            if base_cls is not None:
+                found = self.lookup_method(base_cls, name, _seen | {cls.qualname})
+                if found is not None:
+                    return found
+        return None
+
+    def resolve_class_by_name(self, name: str, mod: ModuleInfo) -> ClassInfo | None:
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        if not rest and head in mod.classes:
+            return mod.classes[head]
+        if head in mod.alias:
+            target = self.resolve_qualified(mod.alias[head] + ("." + rest if rest else ""))
+            if isinstance(target, ClassInfo):
+                return target
+        target = self.resolve_qualified(name)
+        return target if isinstance(target, ClassInfo) else None
+
+    def _infer_attr_types(self, mod: ModuleInfo) -> None:
+        for ci in mod.classes.values():
+            for meth in ci.methods.values():
+                ann_by_param = {}
+                if isinstance(meth.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    args = meth.node.args
+                    for a in args.args + args.kwonlyargs + args.posonlyargs:
+                        ann_by_param[a.arg] = _annotation_classes(a.annotation, mod, self)
+                for node in ast.walk(meth.node):
+                    targets: list[ast.AST] = []
+                    value: ast.AST | None = None
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, ast.AnnAssign) and node.target is not None:
+                        targets, value = [node.target], node.value
+                        ann_types = _annotation_classes(node.annotation, mod, self)
+                    else:
+                        continue
+                    for tgt in targets:
+                        if not (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            continue
+                        types = set()
+                        if isinstance(node, ast.AnnAssign):
+                            types |= ann_types
+                        types |= self._value_classes(value, mod, ann_by_param)
+                        if types:
+                            ci.attr_types.setdefault(tgt.attr, set()).update(types)
+
+    def _value_classes(self, value: ast.AST | None, mod: ModuleInfo,
+                       ann_by_param: dict[str, set[str]]) -> set[str]:
+        out: set[str] = set()
+        if value is None:
+            return out
+        stack = [value]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.IfExp):
+                stack.extend([node.body, node.orelse])
+            elif isinstance(node, ast.BoolOp):
+                stack.extend(node.values)
+            elif isinstance(node, ast.Call):
+                cls = self.resolve_class(node.func, mod)
+                if cls is not None:
+                    out.add(cls.qualname)
+            elif isinstance(node, ast.Name):
+                out |= ann_by_param.get(node.id, set())
+        return out
+
+    def _local_var_types(self, fn: FuncInfo) -> dict[str, set[str]]:
+        types: dict[str, set[str]] = {}
+        mod = fn.module
+        if isinstance(fn.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = fn.node.args
+            for a in args.args + args.kwonlyargs + args.posonlyargs:
+                anns = _annotation_classes(a.annotation, mod, self)
+                if anns:
+                    types[a.arg] = anns
+        for node in _walk_own(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                cls = self.resolve_class(node.value.func, mod)
+                fd = dotted(node.value.func) or ""
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if cls is not None:
+                            types.setdefault(tgt.id, set()).add(cls.qualname)
+                        if fd.endswith(("run_coroutine_threadsafe", ".submit")):
+                            fn.cfutures.add(tgt.id)
+        return types
+
+    # -------------------------------------------------------------- edges
+
+    def resolve_callable_ref(self, expr: ast.AST, fn: FuncInfo) -> list[FuncInfo]:
+        """Resolve an expression used as a callable *value* (Thread target,
+        executor fn, callback) to FuncInfos."""
+        mod = fn.module
+        if isinstance(expr, ast.Lambda):
+            lam = FuncInfo(
+                qualname=f"{fn.qualname}.<lambda:{expr.lineno}>", module=mod,
+                node=expr, cls=fn.cls)
+            lam.local_defs = dict(fn.local_defs)
+            lam.local_types = dict(fn.local_types)
+            self.functions.append(lam)
+            self._build_edges(lam)
+            for e in self.edges:
+                if e.caller is lam:
+                    self._edges_by_caller.setdefault(id(lam), []).append(e)
+                    self._callers_of.setdefault(id(e.callee), []).append(e)
+            return [lam]
+        if isinstance(expr, ast.Call):
+            fd = dotted(expr.func) or ""
+            if fd.rsplit(".", 1)[-1] == "partial" and expr.args:
+                return self.resolve_callable_ref(expr.args[0], fn)
+            return []
+        if isinstance(expr, ast.Name):
+            if expr.id in fn.local_defs:
+                return [fn.local_defs[expr.id]]
+            if expr.id in mod.functions:
+                return [mod.functions[expr.id]]
+            if expr.id in mod.classes:
+                init = self.lookup_method(mod.classes[expr.id], "__init__")
+                return [init] if init else []
+            if expr.id in mod.alias:
+                target = self.resolve_qualified(mod.alias[expr.id])
+                if isinstance(target, FuncInfo):
+                    return [target]
+            return []
+        d = dotted(expr)
+        if d is None:
+            return []
+        return self._resolve_dotted_call(d, fn)
+
+    def _resolve_dotted_call(self, d: str, fn: FuncInfo) -> list[FuncInfo]:
+        mod = fn.module
+        parts = d.split(".")
+        if parts[0] == "self" and fn.cls is not None:
+            if len(parts) == 2:
+                m = self.lookup_method(fn.cls, parts[1])
+                return [m] if m else []
+            if len(parts) == 3:
+                out = []
+                for cq in sorted(fn.cls.attr_types.get(parts[1], ())):
+                    ci = self.classes.get(cq)
+                    if ci:
+                        m = self.lookup_method(ci, parts[2])
+                        if m:
+                            out.append(m)
+                return out
+            return []
+        if parts[0] in fn.local_types and len(parts) == 2:
+            out = []
+            for cq in sorted(fn.local_types[parts[0]]):
+                ci = self.classes.get(cq)
+                if ci:
+                    m = self.lookup_method(ci, parts[1])
+                    if m:
+                        out.append(m)
+            return out
+        if parts[0] in mod.alias:
+            expanded = mod.alias[parts[0]] + ("." + ".".join(parts[1:]) if parts[1:] else "")
+            target = self.resolve_qualified(expanded)
+            if isinstance(target, FuncInfo):
+                return [target]
+            if isinstance(target, ClassInfo):
+                init = self.lookup_method(target, "__init__")
+                return [init] if init else []
+        target = self.resolve_qualified(d)
+        if isinstance(target, FuncInfo):
+            return [target]
+        return []
+
+    def _build_edges(self, fn: FuncInfo) -> None:
+        fn.local_types = self._local_var_types(fn)
+        for node in _walk_own(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            transition = self._transition_of(node, fn)
+            if transition is not None:
+                domain, target_expr = transition
+                if target_expr is not None:
+                    for callee in self.resolve_callable_ref(target_expr, fn):
+                        self.edges.append(Edge(fn, callee, domain, node.lineno))
+                continue
+            d = dotted(node.func)
+            if isinstance(node.func, ast.Name):
+                callees = self.resolve_callable_ref(node.func, fn)
+            elif d is not None:
+                callees = self._resolve_dotted_call(d, fn)
+            else:
+                callees = []
+            for callee in callees:
+                self.edges.append(Edge(fn, callee, None, node.lineno))
+
+    def _transition_of(self, call: ast.Call, fn: FuncInfo):
+        """(domain, target_callable_expr) when ``call`` hops domains."""
+        d = dotted(call.func) or ""
+        last = d.rsplit(".", 1)[-1]
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        if last == "run_in_executor" and len(call.args) >= 2:
+            return (DOMAIN_EXECUTOR, call.args[1])
+        if last == "to_thread" and call.args:
+            return (DOMAIN_EXECUTOR, call.args[0])
+        if last == "submit" and call.args and re.search(
+                r"executor|pool", d, re.IGNORECASE):
+            return (DOMAIN_EXECUTOR, call.args[0])
+        if last == "Thread":
+            target = kw.get("target")
+            return (DOMAIN_DRIVER, target)
+        if last in {"create_task", "ensure_future"} and call.args:
+            arg = call.args[0]
+            return (DOMAIN_EVENT_LOOP, arg.func if isinstance(arg, ast.Call) else arg)
+        if last == "run_coroutine_threadsafe" and call.args:
+            arg = call.args[0]
+            return (DOMAIN_EVENT_LOOP, arg.func if isinstance(arg, ast.Call) else arg)
+        if last in {"call_soon_threadsafe", "call_soon"} and call.args:
+            return (DOMAIN_EVENT_LOOP, call.args[0])
+        if last == "call_later" and len(call.args) >= 2:
+            return (DOMAIN_EVENT_LOOP, call.args[1])
+        if last == "add_done_callback" and call.args:
+            return (DOMAIN_EVENT_LOOP, call.args[0])
+        if last == "run" and d in {"asyncio.run"} and call.args:
+            arg = call.args[0]
+            return (DOMAIN_EVENT_LOOP, arg.func if isinstance(arg, ast.Call) else arg)
+        return None
+
+    # ------------------------------------------------------------- domains
+
+    def _annotation_domain(self, fn: FuncInfo) -> str | None:
+        lines = fn.module.source_lines
+        candidates = []
+        lineno = getattr(fn.node, "lineno", None)
+        if lineno:
+            candidates = [lineno, lineno - 1]
+            deco = getattr(fn.node, "decorator_list", None)
+            if deco:
+                candidates.append(min(d.lineno for d in deco) - 1)
+        for ln in candidates:
+            if 1 <= ln <= len(lines):
+                m = _DOMAIN_DIRECTIVE_RE.search(lines[ln - 1])
+                if m:
+                    return m.group(1)
+        return None
+
+    def _propagate_domains(self) -> None:
+        work: list[FuncInfo] = []
+
+        def seed(fn: FuncInfo, domain: str, why: str) -> None:
+            if domain not in fn.domains:
+                fn.domains.add(domain)
+                fn.witness.setdefault(domain, why)
+                work.append(fn)
+
+        for fn in self.functions:
+            ann = self._annotation_domain(fn)
+            if ann == "any":
+                for d in ALL_DOMAINS:
+                    seed(fn, d, "annotated domain=any")
+            elif ann in ALL_DOMAINS:
+                seed(fn, ann, f"annotated domain={ann}")
+            if fn.is_async:
+                seed(fn, DOMAIN_EVENT_LOOP, "async def")
+        for edge in self.edges:
+            if edge.transition is not None:
+                why = {
+                    DOMAIN_EXECUTOR: "executor target",
+                    DOMAIN_DRIVER: "Thread target",
+                    DOMAIN_EVENT_LOOP: "scheduled on the loop",
+                }[edge.transition]
+                seed(edge.callee, edge.transition,
+                     f"{why} in '{edge.caller.qualname}'")
+
+        while work:
+            fn = work.pop()
+            for edge in self._edges_by_caller.get(id(fn), ()):
+                if edge.transition is not None:
+                    continue
+                callee = edge.callee
+                # a sync caller "calling" an async def just builds the
+                # coroutine object; execution stays loop-side (seeded)
+                if callee.is_async:
+                    continue
+                for d in sorted(fn.domains):
+                    if d not in callee.domains:
+                        callee.domains.add(d)
+                        callee.witness.setdefault(
+                            d, f"called from '{fn.qualname}' "
+                               f"({fn.witness.get(d, d)})")
+                        if callee not in work:
+                            work.append(callee)
+
+
+def _walk_own(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs/classes."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------
+# lock-aware statement walking (shared by WPA002/WPA003)
+
+def _lock_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    return dotted(expr) or "<lock>"
+
+
+def _iter_with_locks(fn: ast.AST):
+    """Yield (node, locks, sync_locks) for every node in the function body,
+    where ``locks`` is the set of lock names acquired around the node (sync
+    *and* async `with`) and ``sync_locks`` is [(name, line)] for sync-held
+    locks only (the ones WPA003 cares about)."""
+
+    def visit(node: ast.AST, locks: frozenset, sync: tuple):
+        yield node, locks, sync
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            names = [_lock_name(item.context_expr) for item in node.items
+                     if _is_lockish(item.context_expr)]
+            inner_locks = locks | frozenset(names)
+            inner_sync = sync
+            if names and isinstance(node, ast.With):
+                inner_sync = sync + tuple((n, node.lineno) for n in names)
+            for item in node.items:
+                yield from visit(item.context_expr, locks, sync)
+            for child in node.body:
+                yield from visit(child, inner_locks, inner_sync)
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, locks, sync)
+
+    for child in ast.iter_child_nodes(fn):
+        yield from visit(child, frozenset(), ())
+
+
+# --------------------------------------------------------------------------
+# WPA001 — blocking call reachable from the event loop
+
+_SOCKET_METHODS = {"sendall", "recv", "recv_into", "accept", "connect", "makefile"}
+
+
+def _blocking_reason(call: ast.Call, fn: FuncInfo,
+                     awaited: set[int]) -> str | None:
+    d = dotted(call.func)
+    if d in _BLOCKING_CALLS:
+        return f"blocking {d}()"
+    if d is None:
+        # run_coroutine_threadsafe(...).result() chained directly
+        if (isinstance(call.func, ast.Attribute) and call.func.attr == "result"
+                and isinstance(call.func.value, ast.Call)):
+            inner = dotted(call.func.value.func) or ""
+            if inner.endswith(("run_coroutine_threadsafe", ".submit")):
+                return "blocking Future.result() on a cross-domain bridge"
+        return None
+    head, _, _ = d.partition(".")
+    last = d.rsplit(".", 1)[-1]
+    if last in _SOCKET_METHODS and re.search(r"sock", d, re.IGNORECASE):
+        return f"blocking socket {d}()"
+    if last in {"result", "exception"} and head in fn.cfutures:
+        return "blocking Future.result() on a cross-domain bridge"
+    if last == "join" and re.search(r"thread", d, re.IGNORECASE):
+        return f"blocking {d}() (thread join)"
+    if last == "wait" and id(call) not in awaited and not re.search(
+            r"cond", d, re.IGNORECASE):
+        return f"un-awaited {d}() (threading-style wait)"
+    return None
+
+
+def check_wpa001(program: Program) -> Iterator[ProgramFinding]:
+    for fn in program.functions:
+        if DOMAIN_EVENT_LOOP not in fn.domains or fn.module.is_test_file:
+            continue
+        awaited = {id(n.value) for n in _walk_own(fn.node)
+                   if isinstance(n, ast.Await)}
+        for node in _walk_own(fn.node):
+            if not isinstance(node, ast.Call) or id(node) in awaited:
+                continue
+            if fn.is_async and dotted(node.func) in _BLOCKING_CALLS:
+                continue  # ASY001 already reports the direct syntactic case
+            reason = _blocking_reason(node, fn, awaited)
+            if reason is None:
+                continue
+            why = fn.witness.get(DOMAIN_EVENT_LOOP, "event_loop")
+            yield ProgramFinding(
+                fn.module.path, node.lineno, node.col_offset, "WPA001",
+                f"{reason} in '{fn.qualname}' runs on the event loop "
+                f"({why}) — every coroutine stalls behind it; move it to "
+                f"an executor or use the async equivalent",
+            )
+
+
+# --------------------------------------------------------------------------
+# WPA002 — cross-domain attribute access with no common lock
+
+@dataclass
+class _Access:
+    attr: str
+    kind: str            # "read" | "write"
+    line: int
+    col: int
+    locks: frozenset
+    method: FuncInfo
+
+
+def _class_accesses(ci: ClassInfo) -> list[_Access]:
+    out: list[_Access] = []
+    for name, meth in ci.methods.items():
+        if not meth.domains:
+            continue
+        init_like = name in {"__init__", "__post_init__"}
+        for node, locks, _sync in _iter_with_locks(meth.node):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            if _LOCK_ATTR_RE.search(node.attr):
+                continue
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                if init_like:
+                    continue  # construction happens-before publication
+                out.append(_Access(node.attr, "write", node.lineno,
+                                   node.col_offset, locks, meth))
+            elif isinstance(node.ctx, ast.Load):
+                out.append(_Access(node.attr, "read", node.lineno,
+                                   node.col_offset, locks, meth))
+    return out
+
+
+_LOCK_ATTR_RE = re.compile(r"lock|sem|mutex|cond|event", re.IGNORECASE)
+
+
+def check_wpa002(program: Program) -> Iterator[ProgramFinding]:
+    for qual in sorted(program.classes):
+        ci = program.classes[qual]
+        if ci.module.is_test_file:
+            continue
+        domains_used = set()
+        for meth in ci.methods.values():
+            domains_used |= meth.domains
+        if len(domains_used) < 2:
+            continue
+        accesses = _class_accesses(ci)
+        by_attr: dict[str, list[_Access]] = {}
+        for acc in accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+        for attr in sorted(by_attr):
+            accs = by_attr[attr]
+            writes = [a for a in accs if a.kind == "write"]
+            # one finding per (attr, writing method): each racy write site
+            # needs its own fix or its own justified suppression
+            seen_methods: set[int] = set()
+            for w in writes:
+                if id(w.method) in seen_methods:
+                    continue
+                for other in accs:
+                    if other is w or other.method is w.method:
+                        continue
+                    cross = {(d1, d2) for d1 in w.method.domains
+                             for d2 in other.method.domains if d1 != d2}
+                    if not cross:
+                        continue
+                    if w.locks & other.locks:
+                        continue
+                    d1, d2 = sorted(cross)[0]
+                    w_locks = ",".join(sorted(w.locks)) or "none"
+                    o_locks = ",".join(sorted(other.locks)) or "none"
+                    yield ProgramFinding(
+                        ci.module.path, w.line, w.col, "WPA002",
+                        f"self.{attr} written in '{w.method.name}' "
+                        f"[{d1}, locks: {w_locks}] and "
+                        f"{other.kind} in '{other.method.name}' "
+                        f"[{d2}, locks: {o_locks}] "
+                        f"({other.method.module.path}:{other.line}) with no "
+                        f"common lock — cross-domain race on "
+                        f"{ci.qualname}",
+                    )
+                    seen_methods.add(id(w.method))
+                    break
+
+
+# --------------------------------------------------------------------------
+# WPA003 — lock held across an await / cross-domain wait
+
+def check_wpa003(program: Program) -> Iterator[ProgramFinding]:
+    for fn in program.functions:
+        if fn.module.is_test_file or not fn.domains:
+            continue
+        for node, _locks, sync in _iter_with_locks(fn.node):
+            if not sync:
+                continue
+            lock_name, lock_line = sync[-1]
+            if isinstance(node, ast.Await):
+                yield ProgramFinding(
+                    fn.module.path, node.lineno, node.col_offset, "WPA003",
+                    f"'{fn.qualname}' awaits while holding sync lock "
+                    f"'{lock_name}' (acquired line {lock_line}) — any other "
+                    f"domain contending for it deadlocks against the loop; "
+                    f"release before awaiting or use asyncio.Lock",
+                )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            last = d.rsplit(".", 1)[-1]
+            bridge = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                    and isinstance(node.func.value, ast.Call)
+                    and (dotted(node.func.value.func) or "").endswith(
+                        ("run_coroutine_threadsafe", ".submit"))):
+                bridge = "Future.result() bridge"
+            elif last in {"result", "exception"} and d.partition(".")[0] in fn.cfutures:
+                bridge = "Future.result() bridge"
+            elif last == "join" and re.search(r"thread", d, re.IGNORECASE):
+                bridge = f"{d}()"
+            if bridge is not None:
+                yield ProgramFinding(
+                    fn.module.path, node.lineno, node.col_offset, "WPA003",
+                    f"'{fn.qualname}' blocks on {bridge} while holding sync "
+                    f"lock '{lock_name}' (acquired line {lock_line}) — if "
+                    f"the other domain needs the same lock this deadlocks",
+                )
+
+
+# --------------------------------------------------------------------------
+# WPA004 — KV-page allocate/release typestate
+
+_ALLOC_METHODS = {"allocate", "share"}
+_RELEASE_METHODS = {"release", "recycle", "free"}
+_POOLISH_RE = re.compile(r"alloc|pool|page", re.IGNORECASE)
+
+OWNED, MAYBE, RELEASED, ESCAPED = "owned", "maybe", "released", "escaped"
+
+
+def _pool_classes(program: Program) -> set[str]:
+    out = set()
+    for qual, ci in program.classes.items():
+        names = set(ci.methods)
+        if names & _ALLOC_METHODS and names & _RELEASE_METHODS:
+            out.add(qual)
+    return out
+
+
+class _PoolOps:
+    """Classifies calls in one function as pool allocate/release ops."""
+
+    def __init__(self, program: Program, fn: FuncInfo, pools: set[str]) -> None:
+        self.program = program
+        self.fn = fn
+        self.pools = pools
+
+    def kind_of(self, call: ast.Call) -> str | None:
+        d = dotted(call.func)
+        if d is None:
+            return None
+        last = d.rsplit(".", 1)[-1]
+        if last not in _ALLOC_METHODS | _RELEASE_METHODS:
+            return None
+        resolved = self.program._resolve_dotted_call(d, self.fn)
+        is_pool = any(m.cls is not None and m.cls.qualname in self.pools
+                      for m in resolved)
+        if not is_pool and not resolved:
+            receiver = d.rsplit(".", 1)[0]
+            is_pool = bool(_POOLISH_RE.search(receiver))
+        if not is_pool:
+            return None
+        return "alloc" if last in _ALLOC_METHODS else "release"
+
+
+@dataclass
+class _TypestateResult:
+    findings: list[tuple[int, int, str]] = field(default_factory=list)
+    commit_attrs: dict[str, tuple[int, int]] = field(default_factory=dict)
+    release_attrs: set[str] = field(default_factory=set)
+
+
+def _analyze_pool_function(program: Program, fn: FuncInfo,
+                           pools: set[str]) -> _TypestateResult:
+    ops = _PoolOps(program, fn, pools)
+    res = _TypestateResult()
+    alloc_line: dict[str, int] = {}
+    derived_from: dict[str, set[str]] = {}
+
+    def names_read(expr: ast.AST | None) -> set[str]:
+        if expr is None:
+            return set()
+        return {n.id for n in ast.walk(expr)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+    def attrs_read(expr: ast.AST | None) -> set[str]:
+        if expr is None:
+            return set()
+        return {n.attr for n in ast.walk(expr)
+                if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load)}
+
+    def alloc_calls(expr: ast.AST | None) -> list[ast.Call]:
+        if expr is None:
+            return []
+        return [n for n in ast.walk(expr)
+                if isinstance(n, ast.Call) and ops.kind_of(n) == "alloc"]
+
+    def handle_release(call: ast.Call, env: dict[str, str]) -> None:
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                state = env.get(arg.id)
+                if state == RELEASED:
+                    res.findings.append((
+                        call.lineno, call.col_offset,
+                        f"double-free: '{arg.id}' released again in "
+                        f"'{fn.qualname}' — pages already returned to the "
+                        f"pool (refcount corruption / page reuse)",
+                    ))
+                elif state in {OWNED, MAYBE}:
+                    env[arg.id] = RELEASED
+                res.release_attrs.update(derived_from.get(arg.id, ()))
+            elif isinstance(arg, ast.Attribute):
+                res.release_attrs.add(arg.attr)
+            else:
+                res.release_attrs.update(attrs_read(arg))
+
+    def handle_calls(stmt: ast.AST, env: dict[str, str]) -> None:
+        """Release calls + owned-var escapes through arbitrary calls."""
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = ops.kind_of(node)
+            if kind == "release":
+                handle_release(node, env)
+            elif kind is None:
+                for name in names_read(node):
+                    if env.get(name) in {OWNED, MAYBE}:
+                        env[name] = ESCAPED
+
+    def leak_check(line: int, col: int, env: dict[str, str], what: str) -> None:
+        for var in sorted(env):
+            if env[var] == OWNED:
+                res.findings.append((
+                    line, col,
+                    f"page leak: '{var}' (allocated line "
+                    f"{alloc_line.get(var, '?')}) is still owned when "
+                    f"'{fn.qualname}' {what} — pages never return to the "
+                    f"pool and the cache fills until OutOfPages",
+                ))
+                env[var] = ESCAPED  # report once
+
+    def merge(a: dict[str, str], b: dict[str, str]) -> dict[str, str]:
+        out = {}
+        for var in set(a) | set(b):
+            sa, sb = a.get(var), b.get(var)
+            out[var] = sa if sa == sb else MAYBE if OWNED in {sa, sb} else (sa or sb)
+        return out
+
+    def run_body(body: list[ast.stmt], env: dict[str, str]) -> dict[str, str]:
+        for stmt in body:
+            env = run_stmt(stmt, env)
+        return env
+
+    def run_stmt(stmt: ast.stmt, env: dict[str, str]) -> dict[str, str]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return env
+        if isinstance(stmt, ast.Assign):
+            allocs = alloc_calls(stmt.value)
+            reads = names_read(stmt.value)
+            handle_calls(stmt.value, env)  # releases / escapes inside value
+            if allocs:
+                # `pages = shared + allocate(...)`: shared is absorbed into
+                # the new handle — it must not double-count as owned
+                for src in reads:
+                    if env.get(src) in {OWNED, MAYBE}:
+                        env[src] = ESCAPED
+                tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+                if isinstance(tgt, ast.Name):
+                    env[tgt.id] = OWNED
+                    alloc_line[tgt.id] = allocs[0].lineno
+                    derived_from.setdefault(tgt.id, set()).update(attrs_read(stmt.value))
+                elif isinstance(tgt, ast.Attribute):
+                    res.commit_attrs.setdefault(
+                        tgt.attr, (stmt.lineno, stmt.col_offset))
+                return env
+            # commit: owned var flows into an attribute
+            owned_reads = [n for n in reads if env.get(n) in {OWNED, MAYBE}]
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Tuple) and isinstance(stmt.value, ast.Tuple) \
+                        and len(tgt.elts) == len(stmt.value.elts):
+                    for t_el, v_el in zip(tgt.elts, stmt.value.elts):
+                        v_names = names_read(v_el)
+                        owned = [n for n in v_names if env.get(n) in {OWNED, MAYBE}]
+                        if isinstance(t_el, ast.Attribute) and owned:
+                            res.commit_attrs.setdefault(
+                                t_el.attr, (stmt.lineno, stmt.col_offset))
+                            for n in owned:
+                                env[n] = ESCAPED
+                        elif isinstance(t_el, ast.Name):
+                            if owned:
+                                env[t_el.id] = OWNED
+                                for n in owned:
+                                    if n != t_el.id:
+                                        env[n] = ESCAPED
+                            derived_from.setdefault(t_el.id, set()).update(
+                                attrs_read(v_el))
+                elif isinstance(tgt, ast.Attribute) and owned_reads:
+                    res.commit_attrs.setdefault(tgt.attr, (stmt.lineno, stmt.col_offset))
+                    for n in owned_reads:
+                        env[n] = ESCAPED
+                elif isinstance(tgt, ast.Name):
+                    if owned_reads:
+                        env[tgt.id] = OWNED
+                        alloc_line.setdefault(
+                            tgt.id, alloc_line.get(owned_reads[0], stmt.lineno))
+                        for n in owned_reads:
+                            if n != tgt.id:
+                                env[n] = ESCAPED
+                    derived_from.setdefault(tgt.id, set()).update(attrs_read(stmt.value))
+            return env
+        if isinstance(stmt, (ast.Expr, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value if not isinstance(stmt, ast.Expr) else stmt.value
+            if value is not None:
+                handle_calls(value, env)
+            return env
+        if isinstance(stmt, ast.Return):
+            handle_calls(stmt, env)
+            for n in names_read(stmt.value):
+                if env.get(n) in {OWNED, MAYBE}:
+                    env[n] = ESCAPED  # ownership transferred to caller
+            leak_check(stmt.lineno, stmt.col_offset, env, "returns")
+            return env
+        if isinstance(stmt, ast.Raise):
+            handle_calls(stmt, env)
+            leak_check(stmt.lineno, stmt.col_offset, env, "raises")
+            return env
+        if isinstance(stmt, ast.If):
+            handle_calls(stmt.test, env)
+            a = run_body(stmt.body, dict(env))
+            b = run_body(stmt.orelse, dict(env))
+            return merge(a, b)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            for name in sorted(
+                    {n.id for n in ast.walk(stmt.target)
+                     if isinstance(n, ast.Name)}):
+                derived_from.setdefault(name, set()).update(attrs_read(stmt.iter))
+            body_env = run_body(stmt.body, dict(env))
+            body_env = run_body(stmt.orelse, body_env)
+            return merge(env, body_env)
+        if isinstance(stmt, ast.While):
+            handle_calls(stmt.test, env)
+            body_env = run_body(stmt.body, dict(env))
+            body_env = run_body(stmt.orelse, body_env)
+            return merge(env, body_env)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                handle_calls(item.context_expr, env)
+            return run_body(stmt.body, env)
+        if isinstance(stmt, ast.Try):
+            pre = dict(env)
+            after_body = run_body(stmt.body, env)
+            # an exception may fire anywhere in the body: handlers see the
+            # uncertain union of before/after states
+            handler_base = merge(pre, after_body)
+            outs = [run_body(stmt.orelse, dict(after_body))]
+            for handler in stmt.handlers:
+                outs.append(run_body(handler.body, dict(handler_base)))
+            merged = outs[0]
+            for o in outs[1:]:
+                merged = merge(merged, o)
+            return run_body(stmt.finalbody, merged)
+        return env
+
+    env = run_body(list(fn.node.body) if not isinstance(fn.node, ast.Lambda) else [],
+                   {})
+    end_line = getattr(fn.node, "end_lineno", None) or getattr(fn.node, "lineno", 1)
+    leak_check(end_line, 0, env, "falls off the end")
+    return res
+
+
+def check_wpa004(program: Program) -> Iterator[ProgramFinding]:
+    pools = _pool_classes(program)
+    if not pools:
+        return
+    commit_sites: dict[str, tuple[str, int, int]] = {}
+    release_attrs: set[str] = set()
+    per_fn: list[tuple[FuncInfo, _TypestateResult]] = []
+    for fn in program.functions:
+        if fn.module.is_test_file or isinstance(fn.node, ast.Lambda):
+            continue
+        if fn.cls is not None and fn.cls.qualname in pools:
+            continue  # the pool's own internals manage freelists, not handles
+        ops = _PoolOps(program, fn, pools)
+        has_op = any(isinstance(n, ast.Call) and ops.kind_of(n) is not None
+                     for n in _walk_own(fn.node))
+        if not has_op:
+            continue
+        result = _analyze_pool_function(program, fn, pools)
+        per_fn.append((fn, result))
+        for attr, (line, col) in result.commit_attrs.items():
+            commit_sites.setdefault(attr, (fn.module.path, line, col))
+        release_attrs |= result.release_attrs
+    for fn, result in per_fn:
+        for line, col, message in result.findings:
+            yield ProgramFinding(fn.module.path, line, col, "WPA004", message)
+    for attr in sorted(commit_sites):
+        if attr in release_attrs:
+            continue
+        path, line, col = commit_sites[attr]
+        yield ProgramFinding(
+            path, line, col, "WPA004",
+            f"pages committed to '.{attr}' but no code path ever releases "
+            f"pages read back from '.{attr}' — committed pages can never "
+            f"return to the pool",
+        )
+
+
+# --------------------------------------------------------------------------
+# registry + entry point
+
+_WPA_CHECKS = {
+    "WPA001": check_wpa001,
+    "WPA002": check_wpa002,
+    "WPA003": check_wpa003,
+    "WPA004": check_wpa004,
+}
+
+
+def _register_program_rule(rule_id: str, summary: str, details: str) -> None:
+    # program rules run in analyze_program, not the per-file loop; the
+    # no-op checker keeps the Rule interface uniform for reporters
+    RULES[rule_id] = Rule(rule_id, summary, details, lambda ctx: iter(()))
+
+
+_register_program_rule(
+    "WPA001",
+    "blocking call transitively reachable from the event loop",
+    "The transitive closure of ASY001: a sync helper that sleeps, does "
+    "socket I/O, joins a thread, or blocks on a bridge Future is called "
+    "(possibly many frames deep) from a function the domain inference "
+    "places on the event loop. Every coroutine in the process stalls.",
+)
+_register_program_rule(
+    "WPA002",
+    "cross-domain attribute access with no common lock",
+    "An attribute of a shared object is written in one execution domain "
+    "and read in another, and the acquired-lock-sets at the two sites "
+    "share no lock. This is the ASY002 race shape made cross-module: "
+    "driver thread vs event loop vs executor.",
+)
+_register_program_rule(
+    "WPA003",
+    "lock held across an await or a domain-transition wait",
+    "Awaiting (or blocking on run_coroutine_threadsafe(...).result() / "
+    "Thread.join()) while holding a sync lock invites a lock-order "
+    "deadlock between the event loop and the driver/executor domains.",
+)
+_register_program_rule(
+    "WPA004",
+    "KV page allocate/release typestate violation",
+    "Every path from a page-pool allocate()/share() must reach exactly "
+    "one commit or release(): an early return/raise that drops an owned "
+    "page handle leaks device pages until OutOfPages; releasing twice "
+    "corrupts refcounts and recycles live pages.",
+)
+
+
+def analyze_program(files: list[tuple[str, ast.Module, str]]) -> list[ProgramFinding]:
+    """Run the whole-program pass. ``files`` = [(display_path, tree, source)]."""
+    program = Program.build(files)
+    findings: list[ProgramFinding] = []
+    for rule_id in sorted(_WPA_CHECKS):
+        findings.extend(_WPA_CHECKS[rule_id](program))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

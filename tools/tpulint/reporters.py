@@ -3,12 +3,17 @@
 The JSON shape is stable API for CI consumers:
 
     {
-      "version": 1,
+      "version": 2,
       "findings": [{"path", "line", "col", "rule", "message",
-                    "suppressed", "justification"}, ...],
-      "stats": {"files", "findings", "unsuppressed", "suppressed"},
+                    "suppressed", "justification", "qualname",
+                    "baselined"}, ...],
+      "stats": {"files", "findings", "unsuppressed", "suppressed",
+                "baselined"},
       "rules": {"TPU001": "<summary>", ...}
     }
+
+Version history: v1 had no qualname/baselined fields and no baselined
+stat; consumers pinning v1 must update when reading v2 output.
 """
 
 from __future__ import annotations
@@ -19,20 +24,27 @@ from typing import Iterable
 from tools.tpulint.core import Finding
 from tools.tpulint.rules import RULES
 
-JSON_SCHEMA_VERSION = 1
+JSON_SCHEMA_VERSION = 2
 
 
 def render_text(findings: Iterable[Finding], stats: dict, show_suppressed: bool = False) -> str:
     lines: list[str] = []
     for f in findings:
-        if f.suppressed and not show_suppressed:
+        if (f.suppressed or f.baselined) and not show_suppressed:
             continue
-        suffix = f"  [suppressed: {f.justification}]" if f.suppressed else ""
+        suffix = ""
+        if f.suppressed:
+            suffix = f"  [suppressed: {f.justification}]"
+        elif f.baselined:
+            suffix = "  [baselined]"
         lines.append(f"{f.location()}: {f.rule} {f.message}{suffix}")
-    lines.append(
+    summary = (
         f"tpulint: {stats['files']} files, {stats['unsuppressed']} finding(s), "
         f"{stats['suppressed']} suppressed"
     )
+    if stats.get("baselined"):
+        summary += f", {stats['baselined']} baselined"
+    lines.append(summary)
     return "\n".join(lines)
 
 
@@ -48,6 +60,8 @@ def render_json(findings: Iterable[Finding], stats: dict) -> str:
                 "message": f.message,
                 "suppressed": f.suppressed,
                 "justification": f.justification,
+                "qualname": f.qualname,
+                "baselined": f.baselined,
             }
             for f in findings
         ],
