@@ -270,6 +270,23 @@ class Settings:
     # and doubles effective page capacity (kv_cache.quantize_kv_paged:
     # per-page scales riding the decode kernel's scalar-prefetch channel)
     kv_quant: bool = field(default_factory=lambda: _env_bool("KV_QUANT", False))
+    # host-RAM KV page tier (serving/kv_cache.TieredPageAllocator): cold
+    # registered prefix pages write back to host RAM at step boundaries
+    # and fault back in on re-admission, so the prefix cache extends past
+    # HBM under oversubscribed concurrency.  "on" forces it, "off"
+    # disables, "auto" enables iff KV_HOST_POOL_PAGES > 0.
+    kv_tier: str = field(default_factory=lambda: os.getenv("KV_TIER", "auto"))
+    # host-tier capacity in pages; 0 with KV_TIER=on sizes it at
+    # 4x KV_NUM_PAGES (v5e-8: ~192 GB host RAM vs 16 GB HBM/chip — the
+    # host pool is bounded by RAM you give the container, see README)
+    kv_host_pool_pages: int = field(
+        default_factory=lambda: _env_int("KV_HOST_POOL_PAGES", 0)
+    )
+    # pages per migration dispatch; compiled migration shapes are the
+    # power-of-two buckets up to this (warmup-precompiled)
+    kv_migrate_burst: int = field(
+        default_factory=lambda: _env_int("KV_MIGRATE_BURST", 8)
+    )
     # MoE serving expert capacity = ceil(K*T/E * factor); overflow
     # assignments drop that expert's contribution (models/moe.py; set
     # MOE_DROP_STATS=1 to count drops).  0 = exact no-drop dispatch —

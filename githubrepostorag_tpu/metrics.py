@@ -175,6 +175,46 @@ SCHED_STALL = Gauge(
     "(scheduler stall; 0 when idle)",
     registry=REGISTRY,
 )
+KV_TIER_DEVICE_PAGES = Gauge(
+    "rag_kv_tier_device_free_pages",
+    "Allocatable device KV pages (free list + evictable cached pages)",
+    registry=REGISTRY,
+)
+KV_TIER_HOST_PAGES = Gauge(
+    "rag_kv_tier_host_pages",
+    "KV pages resident in the host-RAM swap tier (by chain hash)",
+    registry=REGISTRY,
+)
+KV_FAULT_INS = Counter(
+    "rag_kv_tier_fault_ins_total",
+    "Prefix pages re-admitted host->device instead of recomputed",
+    registry=REGISTRY,
+)
+KV_WRITEBACKS = Counter(
+    "rag_kv_tier_writebacks_total",
+    "Cold device pages saved device->host at step boundaries",
+    registry=REGISTRY,
+)
+KV_DEDUP_HITS = Counter(
+    "rag_kv_tier_dedup_hits_total",
+    "share() hits on pages other concurrent requests actively hold "
+    "(cross-user prefix-page dedup)",
+    registry=REGISTRY,
+)
+KV_DEDUP_HOLDS = Counter(
+    "rag_kv_tier_dedup_holds_total",
+    "Admissions held one registration for an identical prefix mid-prefill "
+    "instead of duplicating its footprint",
+    registry=REGISTRY,
+)
+KV_MIGRATION_SECONDS = Histogram(
+    "rag_kv_tier_migration_seconds",
+    "Per-step host time spent planning/dispatching/landing page migration "
+    "(writeback gathers + fault-in scatters)",
+    registry=REGISTRY,
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1),
+)
 MOE_ASSIGNMENTS = Counter(
     "rag_moe_expert_assignments_total",
     "MoE router token->expert assignments offered (MOE_DROP_STATS=1)",

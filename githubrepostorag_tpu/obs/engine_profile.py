@@ -52,6 +52,7 @@ DEFAULT_JIT_MODULES = (
     "githubrepostorag_tpu.models.qwen2",
     "githubrepostorag_tpu.ops.sampling",
     "githubrepostorag_tpu.ops.packed_prefill",
+    "githubrepostorag_tpu.ops.page_migration",
 )
 
 
@@ -189,9 +190,16 @@ def record_engine_spans(result: Any, parent: TraceContext | None) -> None:
     if submit is not None and pstart is not None:
         record_span("engine.queue_wait", submit, pstart, parent=parent, attrs=attrs)
     if pstart is not None and ftok is not None:
-        record_span("engine.prefill", pstart, ftok, parent=parent, attrs={
+        psp = record_span("engine.prefill", pstart, ftok, parent=parent, attrs={
             **attrs, "prompt_tokens": len(getattr(result, "prompt_tokens", ()) or ()),
         })
+        if psp is not None:
+            # KV tiering: prefix pages this admission swapped in from the
+            # host tier instead of recomputing — the flight recorder shows
+            # the swap right on the request's prefill timeline
+            faulted = getattr(result, "faulted_pages", 0)
+            if faulted:
+                psp.add_event("kv_fault_in", pages=faulted)
     if ftok is not None and done > ftok:
         sp = record_span("engine.decode", ftok, done, parent=parent, attrs={
             **attrs, "output_tokens": len(getattr(result, "output_tokens", ()) or ()),

@@ -1,0 +1,85 @@
+"""Batched device<->host KV page migration dispatches.
+
+Two programs per migration-burst bucket: ``gather_pages`` reads a burst of
+pages out of the pools into a fresh contiguous buffer (the engine starts
+its host DMA with ``copy_to_host_async`` and reads it ONE step later, so
+the driver thread never blocks on the transfer), and ``scatter_pages``
+writes a burst of host payloads back into the pools (donated — XLA updates
+the pools in place, same commit economics as the prefill/decode scatters).
+
+Both take a fixed-width ``[nb]`` page-index vector padded with -1 so the
+compiled-shape zoo is exactly the power-of-two bucket ladder warmup
+precompiles: gather clamps padding to page 0 (the rows are discarded
+host-side), scatter drops padding via out-of-bounds semantics.  Quantized
+(int8) pools migrate their per-page scales alongside the payload in the
+same program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def migrate_buckets(burst: int) -> list[int]:
+    """Power-of-two bucket ladder for migration burst sizes, capped at the
+    configured burst: the complete set of compiled shapes warmup builds."""
+    out: set[int] = set()
+    b = 1
+    while b < burst:
+        out.add(b)
+        b *= 2
+    out.add(max(1, burst))
+    return sorted(out)
+
+
+@jax.jit
+def gather_pages(
+    k_pages: jnp.ndarray,  # [L, n_kv, P, ps, hd]
+    v_pages: jnp.ndarray,
+    idx: jnp.ndarray,  # [nb] int32 page indices, -1 padding
+    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P] f32 (int8 pools)
+    v_scales: jnp.ndarray | None = None,
+):
+    """Read a migration burst into fresh [L, n_kv, nb, ps, hd] buffers.
+
+    NOT donated: the pools stay live (device->host is a residency copy,
+    not a release).  Padding indices clamp to page 0 — the engine only
+    consumes the first ``len(plan)`` rows of the result."""
+    safe = jnp.maximum(idx, 0)
+    k = jnp.take(k_pages, safe, axis=2)
+    v = jnp.take(v_pages, safe, axis=2)
+    ks = None if k_scales is None else jnp.take(k_scales, safe, axis=2)
+    vs = None if v_scales is None else jnp.take(v_scales, safe, axis=2)
+    return k, v, ks, vs
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 4, 5))
+def scatter_pages(
+    k_pages: jnp.ndarray,  # [L, n_kv, P, ps, hd] donated
+    v_pages: jnp.ndarray,  # donated
+    idx: jnp.ndarray,  # [nb] int32 page indices, -1 padding
+    k_vals: jnp.ndarray,  # [L, n_kv, nb, ps, hd] host payloads
+    k_scales: jnp.ndarray | None = None,  # [L, n_kv, P] f32, donated
+    v_scales: jnp.ndarray | None = None,  # donated
+    v_vals: jnp.ndarray | None = None,  # split from k_vals' position so the
+    # donated args stay at fixed argnums; always passed by the engine
+    ks_vals: jnp.ndarray | None = None,  # [L, n_kv, nb] f32
+    vs_vals: jnp.ndarray | None = None,
+):
+    """Write a fault-in burst into the pools at ``idx`` (padding drops via
+    out-of-bounds scatter semantics, the pools are donated so XLA commits
+    in place).  Returns (k_pages, v_pages, k_scales, v_scales)."""
+    # -1 padding must be remapped to an index that is ACTUALLY out of
+    # bounds: jnp normalizes negative indices (-1 -> P-1) before the
+    # mode="drop" check, which would overwrite the pool's last page with
+    # the padding rows' zeros on every non-full burst
+    safe = jnp.where(idx < 0, k_pages.shape[2], idx)
+    k_pages = k_pages.at[:, :, safe].set(k_vals, mode="drop")
+    v_pages = v_pages.at[:, :, safe].set(v_vals, mode="drop")
+    if k_scales is not None:
+        k_scales = k_scales.at[:, :, safe].set(ks_vals, mode="drop")
+        v_scales = v_scales.at[:, :, safe].set(vs_vals, mode="drop")
+    return k_pages, v_pages, k_scales, v_scales

@@ -129,6 +129,9 @@ async def serve(host: str, port: int) -> None:
             kv_quant=s.kv_quant,
             mesh=mesh,
             prefix_caching=s.prefix_caching,
+            kv_tier=s.kv_tier,
+            kv_host_pool_pages=s.kv_host_pool_pages,
+            kv_migrate_burst=s.kv_migrate_burst,
             prefill_priority=s.prefill_priority,
             sp_prefill_threshold=s.sp_prefill_threshold or None,
             spec_ngram_k=s.spec_ngram_k,

@@ -46,7 +46,8 @@ class AsyncEngine:
         # the full cumulative totals
         self._exported = {"hit": 0, "prop": 0, "acc": 0,
                           "packed_tok": 0, "packed_pad": 0, "reaps": 0,
-                          "fb": {}}
+                          "fb": {}, "kv_fault": 0, "kv_wb": 0,
+                          "kv_dedup": 0, "kv_hold": 0, "kv_mig_s": 0.0}
         # step profiler: scheduler-stall gauge + XLA compile watchdog,
         # sampled once per step on the driver thread (obs/engine_profile)
         self.profiler = EngineStepProfiler()
@@ -86,6 +87,13 @@ class AsyncEngine:
             ENGINE_DEADLINE_REAPS,
             ENGINE_RUNNING,
             ENGINE_WAITING,
+            KV_DEDUP_HITS,
+            KV_DEDUP_HOLDS,
+            KV_FAULT_INS,
+            KV_MIGRATION_SECONDS,
+            KV_TIER_DEVICE_PAGES,
+            KV_TIER_HOST_PAGES,
+            KV_WRITEBACKS,
             PACKED_PREFILL_PADDING,
             PACKED_PREFILL_TOKENS,
             PREFIX_CACHE_HITS,
@@ -121,9 +129,30 @@ class AsyncEngine:
             PACKED_PREFILL_PADDING.inc(ppad - last["packed_pad"])
             reaps = self.engine.deadline_reaps
             ENGINE_DEADLINE_REAPS.inc(reaps - last["reaps"])
+            alloc = self.engine._allocator
+            fi = getattr(alloc, "fault_ins", 0)
+            wb = getattr(alloc, "writebacks", 0)
+            dd = getattr(alloc, "dedup_hits", 0)
+            hold = getattr(self.engine, "dedup_holds", 0)
+            mig_s = (
+                getattr(self.engine, "migration_seconds_total", 0.0)
+                + getattr(self.engine, "fault_in_seconds_total", 0.0)
+            )
+            KV_FAULT_INS.inc(fi - last["kv_fault"])
+            KV_WRITEBACKS.inc(wb - last["kv_wb"])
+            KV_DEDUP_HITS.inc(dd - last["kv_dedup"])
+            KV_DEDUP_HOLDS.inc(hold - last["kv_hold"])
+            if mig_s > last["kv_mig_s"]:
+                # one observation per step that migrated: this step's
+                # migration host time (the cumulative totals' delta)
+                KV_MIGRATION_SECONDS.observe(mig_s - last["kv_mig_s"])
+            KV_TIER_DEVICE_PAGES.set(alloc.free_count)
+            KV_TIER_HOST_PAGES.set(getattr(alloc, "host_pages", 0))
             last.update(hit=hit, prop=self.engine.spec_proposed,
                         acc=self.engine.spec_accepted,
-                        packed_tok=ptok, packed_pad=ppad, reaps=reaps)
+                        packed_tok=ptok, packed_pad=ppad, reaps=reaps,
+                        kv_fault=fi, kv_wb=wb, kv_dedup=dd, kv_hold=hold,
+                        kv_mig_s=mig_s)
 
         from githubrepostorag_tpu.metrics import TPOT
 
@@ -232,4 +261,9 @@ class AsyncEngine:
                     getattr(self.engine, "spec_fallbacks", {}).values()
                 ),
                 "deadline_reaps": self.engine.deadline_reaps,
+                "kv_host_pages": getattr(self.engine._allocator, "host_pages", 0),
+                "kv_fault_ins": getattr(self.engine._allocator, "fault_ins", 0),
+                "kv_writebacks": getattr(self.engine._allocator, "writebacks", 0),
+                "kv_dedup_hits": getattr(self.engine._allocator, "dedup_hits", 0),
+                "kv_dedup_holds": getattr(self.engine, "dedup_holds", 0),
             }

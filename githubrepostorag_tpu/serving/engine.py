@@ -40,10 +40,16 @@ from githubrepostorag_tpu.models.qwen2 import (
     forward_paged_packed,
 )
 from githubrepostorag_tpu.ops.sampling import sample_tokens
+from githubrepostorag_tpu.ops.page_migration import (
+    gather_pages,
+    migrate_buckets,
+    scatter_pages,
+)
 from githubrepostorag_tpu.serving.kv_cache import (
     OutOfPages,
     PageAllocator,
     PrefixCachingAllocator,
+    TieredPageAllocator,
     make_page_pools,
     packed_slot_mapping,
     page_hashes,
@@ -79,6 +85,9 @@ class GenerationResult:
     spec_proposed: int = 0
     spec_accepted: int = 0
     spec_fallback: str | None = None
+    # KV tiering: prefix pages this request re-admitted from the host tier
+    # instead of recomputing (0 on untiered engines)
+    faulted_pages: int = 0
 
 
 @dataclass
@@ -113,6 +122,11 @@ class _Request:
     spec_fallback: str | None = None
     spec_proposed_req: int = 0
     spec_accepted_req: int = 0
+    # KV tiering: chain hashes this admission promised to register (the
+    # pending-claim dedup contract — released claims unblock followers)
+    # and prefix pages served by host->device fault-in
+    claimed_hashes: list[bytes] = field(default_factory=list)
+    faulted_pages: int = 0
 
 
 from githubrepostorag_tpu.utils import next_bucket as _bucket
@@ -165,6 +179,19 @@ class Engine:
         # fixed tax; >1 trades compile time for step latency
         mesh=None,  # jax.sharding.Mesh -> TP-shard params, KV pools, compute
         prefix_caching: bool = True,  # vLLM automatic-prefix-caching analog
+        kv_tier: str = "auto",  # host-RAM KV page tier behind the block
+        # tables (serving/kv_cache.TieredPageAllocator): "on" forces it,
+        # "off" disables, "auto" enables iff kv_host_pool_pages > 0.
+        # Requires prefix_caching — tier residency is keyed by the prefix
+        # chain hashes.  Cold registered pages write back to host RAM at
+        # step boundaries and fault back in on re-admission, so "free"
+        # host memory extends the prefix cache past HBM.
+        kv_host_pool_pages: int = 0,  # host-tier capacity in pages; with
+        # kv_tier="on" and 0 the engine sizes it at 4x num_pages (v5e-8
+        # host RAM is ~12x a chip's HBM — see README sizing note)
+        kv_migrate_burst: int = 8,  # pages per migration dispatch; the
+        # compiled-shape set is the power-of-two bucket ladder up to this
+        # (warmup precompiles gather + scatter at every bucket)
         prefill_priority: bool = False,  # skip the decode burst on steps
         # where a prefill chunk ran and prompts are still pending — the
         # vLLM prefill-prioritized schedule.  Running streams stall while
@@ -285,9 +312,39 @@ class Engine:
             self._replicated = NamedSharding(mesh, PS())
         self.prefix_caching = prefix_caching
         self.prefill_priority = prefill_priority
-        self._allocator = (
-            PrefixCachingAllocator(num_pages) if prefix_caching else PageAllocator(num_pages)
+        if kv_tier not in ("auto", "on", "off"):
+            raise ValueError(f"kv_tier must be 'auto'|'on'|'off', got {kv_tier!r}")
+        if kv_tier == "on" and not prefix_caching:
+            raise ValueError(
+                "kv_tier='on' requires prefix_caching (host-tier residency "
+                "is keyed by prefix chain hashes)"
+            )
+        self._kv_tier_on = prefix_caching and (
+            kv_tier == "on" or (kv_tier == "auto" and kv_host_pool_pages > 0)
         )
+        self.kv_migrate_burst = max(1, kv_migrate_burst)
+        if self._kv_tier_on:
+            self._allocator = TieredPageAllocator(
+                num_pages,
+                host_pool_pages=(
+                    kv_host_pool_pages if kv_host_pool_pages > 0 else 4 * num_pages
+                ),
+                migrate_burst=self.kv_migrate_burst,
+            )
+        elif prefix_caching:
+            self._allocator = PrefixCachingAllocator(num_pages)
+        else:
+            self._allocator = PageAllocator(num_pages)
+        # in-flight writeback gathers: [(device bufs tuple, hashes)] — the
+        # gather + copy_to_host_async dispatch at step N, the np reads (and
+        # allocator complete_writeback calls) happen at step N+1, so the
+        # driver thread never waits on a device->host DMA it just started
+        self._wb_pending: list[tuple[tuple, list[bytes]]] = []
+        self.kv_migrations = 0  # stats: writeback bursts dispatched
+        self.kv_fault_dispatches = 0  # stats: fault-in scatter bursts
+        self.dedup_holds = 0  # stats: admissions held for a pending twin
+        self.migration_seconds_total = 0.0  # writeback plan/dispatch/land
+        self.fault_in_seconds_total = 0.0  # fault-in stage/dispatch
         self.sp_prefill_threshold = sp_prefill_threshold
         self._sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         self.sp_prefills = 0  # stats: prompts served by the ring-prefill path
@@ -493,6 +550,8 @@ class Engine:
         self._rejected.clear()
         self._reap_expired()
         self._reap_cancelled(finished)
+        if self._kv_tier_on:
+            self._migrate_pages()
 
         prefilled = self._try_prefill(finished)
         running = [r for r in self._row_req.values() if r.state == "running"]
@@ -559,6 +618,134 @@ class Engine:
                 finished.append(self._result(
                     req, "deadline" if req.deadline_expired else "cancelled"))
 
+    def _migrate_pages(self) -> bool:
+        """Step-boundary device->host page migration (tiered engines only).
+
+        Two halves, neither blocking the device:
+          1. LAND the previous boundary's in-flight writeback gathers.
+             Their ``copy_to_host_async`` DMAs had a whole engine step to
+             stream out, so the host reads here wait (if at all) on
+             transfers that are already done, and each page payload
+             publishes to the allocator's host map under its chain hash.
+          2. PLAN + DISPATCH a new gather burst over the coldest parked
+             pages not yet saved (``TieredPageAllocator.evict`` — a
+             residency transition, not a release: the pages stay device
+             shareable until ``allocate`` reclaims them).  Dispatch-only;
+             the result is read at the NEXT boundary (half 1).
+
+        Returns True if any work happened (flush_kv_migrations loops on it).
+        """
+        t0 = time.monotonic()
+        moved = False
+        alloc = self._allocator
+        for bufs, hashes in self._wb_pending:
+            host = [None if a is None else np.asarray(a) for a in bufs]
+            for i, h in enumerate(hashes):
+                # copy the slice: a view would pin the whole burst buffer
+                # in host RAM for as long as any one page stays cached
+                alloc.complete_writeback(
+                    h,
+                    tuple(None if a is None else a[:, :, i].copy() for a in host),
+                )
+            moved = True
+        self._wb_pending.clear()
+        plan = alloc.evict(self.kv_migrate_burst)
+        if plan:
+            nb = _bucket(len(plan), self.kv_migrate_burst, minimum=1)
+            idx_np = np.full((nb,), -1, dtype=np.int32)
+            idx_np[: len(plan)] = [p for p, _ in plan]
+            idx = jnp.asarray(idx_np)
+            k, v, ks, vs = gather_pages(
+                self._k_pages, self._v_pages, idx, self._k_scales, self._v_scales
+            )
+            dk = dv = None
+            if self._draft_enabled:
+                # draft pools share page indices with the target pools — a
+                # faulted-in page must restore BOTH, or drafting on the
+                # re-admitted row would propose from another request's KV
+                # (verify keeps outputs token-identical, but acceptance
+                # would silently collapse)
+                dk, dv, _, _ = gather_pages(self._dk_pages, self._dv_pages, idx)
+            bufs = (k, v, ks, vs, dk, dv)
+            for arr in bufs:
+                if arr is not None and hasattr(arr, "copy_to_host_async"):
+                    arr.copy_to_host_async()
+            self._wb_pending.append((bufs, [h for _, h in plan]))
+            self.kv_migrations += 1
+            moved = True
+        if moved:
+            self.migration_seconds_total += time.monotonic() - t0
+        return moved
+
+    def _dispatch_fault_ins(self) -> None:
+        """Scatter staged host->device page payloads into the pools.
+
+        MUST dispatch before any program that could read the faulted pages
+        this step (_try_prefill calls it right after the admission loop):
+        the device serializes programs on the donated pools, so dispatch
+        order alone makes the faulted content visible to the admitted rows'
+        prefill and every later decode — no host sync, decode never stalls
+        on migration."""
+        staged = self._allocator.fault_in()
+        if not staged:
+            return
+        t0 = time.monotonic()
+        ps, hd = self.page_size, self.cfg.head_dim
+        L, n_kv = self.cfg.num_layers, self.cfg.num_kv_heads
+        quant = self._k_scales is not None
+        while staged:
+            burst = staged[: self.kv_migrate_burst]
+            staged = staged[self.kv_migrate_burst:]
+            nb = _bucket(len(burst), self.kv_migrate_burst, minimum=1)
+            idx = np.full((nb,), -1, dtype=np.int32)
+            k_vals = np.zeros((L, n_kv, nb, ps, hd), dtype=self._k_pages.dtype)
+            v_vals = np.zeros_like(k_vals)
+            ks_vals = np.zeros((L, n_kv, nb), dtype=np.float32) if quant else None
+            vs_vals = np.zeros((L, n_kv, nb), dtype=np.float32) if quant else None
+            dk_vals = dv_vals = None
+            if self._draft_enabled:
+                dshape = (self.draft_cfg.num_layers, self.draft_cfg.num_kv_heads,
+                          nb, ps, self.draft_cfg.head_dim)
+                dk_vals = np.zeros(dshape, dtype=self._dk_pages.dtype)
+                dv_vals = np.zeros(dshape, dtype=self._dv_pages.dtype)
+            for i, (page, payload) in enumerate(burst):
+                pk, pv, pks, pvs, pdk, pdv = payload
+                idx[i] = page
+                k_vals[:, :, i] = pk
+                v_vals[:, :, i] = pv
+                if quant:
+                    ks_vals[:, :, i] = pks
+                    vs_vals[:, :, i] = pvs
+                if dk_vals is not None and pdk is not None:
+                    dk_vals[:, :, i] = pdk
+                    dv_vals[:, :, i] = pdv
+            idx_d = jnp.asarray(idx)
+            (self._k_pages, self._v_pages, self._k_scales,
+             self._v_scales) = scatter_pages(
+                self._k_pages, self._v_pages, idx_d, jnp.asarray(k_vals),
+                self._k_scales, self._v_scales,
+                v_vals=jnp.asarray(v_vals),
+                ks_vals=None if ks_vals is None else jnp.asarray(ks_vals),
+                vs_vals=None if vs_vals is None else jnp.asarray(vs_vals),
+            )
+            if dk_vals is not None:
+                self._dk_pages, self._dv_pages, _, _ = scatter_pages(
+                    self._dk_pages, self._dv_pages, idx_d,
+                    jnp.asarray(dk_vals), v_vals=jnp.asarray(dv_vals),
+                )
+            self.kv_fault_dispatches += 1
+        self.fault_in_seconds_total += time.monotonic() - t0
+
+    def flush_kv_migrations(self) -> None:
+        """Run migration boundaries until quiescent — every plannable
+        writeback dispatched AND landed.  Tests/bench use this for a
+        deterministic host-tier state between traffic phases; the serving
+        loop never needs it (step() makes incremental progress)."""
+        if not self._kv_tier_on:
+            return
+        while self._migrate_pages():
+            pass
+
     def _register_full_pages(self, req: _Request) -> None:
         """Publish every prompt page prefill has completed so far: its KV is
         final (decode writes land past the prompt), so identical prefixes
@@ -572,6 +759,11 @@ class Engine:
         while req.pages_registered < full:
             j = req.pages_registered
             self._allocator.register(req.page_hashes[j], req.pages[j])
+            if req.claimed_hashes and req.claimed_hashes[0] == req.page_hashes[j]:
+                # the registration this admission promised has landed —
+                # drop the pending claim so held followers can share it
+                req.claimed_hashes.pop(0)
+                self._allocator.unclaim([req.page_hashes[j]])
             req.pages_registered = j + 1
 
     def _sp_eligible(self, req: _Request) -> bool:
@@ -691,6 +883,20 @@ class Engine:
             req = self._waiting[0]
             need, hashes = self._head_need_hashes(req)
             assert need <= self.max_pages_per_seq, "intake clamp must bound the page need"
+            if self._kv_tier_on and hashes:
+                pending = self._allocator.pending_claim_pages(hashes)
+                if pending and self._allocator.plain_free_count < need:
+                    # an identical prefix is mid-prefill on another row and
+                    # pages are tight: hold one registration instead of
+                    # duplicating the leader's whole footprint (cross-user
+                    # dedup under oversubscription).  Bounded wait — the
+                    # leader's registration or release (reap/cancel incl.)
+                    # drops the claim and unblocks the queue next step.
+                    self.dedup_holds += 1
+                    break
+            faults_before = (
+                self._allocator.fault_ins if self._kv_tier_on else 0
+            )
             shared = self._allocator.share(hashes) if hashes else []
             try:
                 pages = shared + self._allocator.allocate(need - len(shared))
@@ -701,6 +907,15 @@ class Engine:
             row = self._free_rows.pop()
             req.row, req.pages, req.state = row, pages, "prefilling"
             req.prefill_start_t = time.monotonic()
+            if self._kv_tier_on:
+                req.faulted_pages = self._allocator.fault_ins - faults_before
+                claimed = hashes[len(shared):]
+                if claimed:
+                    # promise the pages this prefill will register, so
+                    # identical-prefix followers can wait for one
+                    # registration instead of allocating twins
+                    self._allocator.claim(claimed)
+                    req.claimed_hashes = list(claimed)
             # cache hit: prefill resumes after the shared pages' tokens
             req.cached_tokens = len(shared) * self.page_size
             req.prefill_pos = req.cached_tokens
@@ -717,6 +932,10 @@ class Engine:
             self._set_row_sampling(row, req.sampling)
             if req.cached_tokens:
                 cached_admits.append(req)
+        if self._kv_tier_on:
+            # scatter any fault-ins share() staged during admission BEFORE
+            # the prefill/decode programs below can read those pages
+            self._dispatch_fault_ins()
         if cached_admits:
             # skipped prefixes still count for repetition penalty: mark
             # their tokens in the presence mask — ONE batched dispatch per
@@ -1593,6 +1812,11 @@ class Engine:
             finished.append(self._result(req, "length"))
 
     def _release(self, req: _Request) -> None:
+        if req.claimed_hashes:
+            # an unfinished prefill abandons its registration promises
+            # (reap/cancel mid-prefill) so held followers aren't stranded
+            self._allocator.unclaim(req.claimed_hashes)
+            req.claimed_hashes = []
         if req.row >= 0:
             if self._chain is not None:
                 # an in-flight burst still reads this row's pages; recycle
@@ -1651,6 +1875,7 @@ class Engine:
             spec_proposed=req.spec_proposed_req,
             spec_accepted=req.spec_accepted_req,
             spec_fallback=req.spec_fallback,
+            faulted_pages=req.faulted_pages,
         )
 
     # --------------------------------------------------------- convenience --
@@ -1819,6 +2044,41 @@ class Engine:
                     jnp.zeros((nb,), dtype=jnp.int32),
                     self.cfg.vocab_size,
                 )
+        if self._kv_tier_on:
+            # compile the migration ladder — one gather + one scatter per
+            # power-of-two burst bucket (per pool set).  All-(-1) indices
+            # make the scatters drop every row and the gathers read page 0,
+            # so each call is a pure shape compile over the live pools
+            # (donated -> rebind); live migration can then never mint a
+            # new program mid-traffic (CompileWatchdog-enforced in tests)
+            ps, hd = self.page_size, self.cfg.head_dim
+            L, n_kv = self.cfg.num_layers, self.cfg.num_kv_heads
+            quant = self._k_scales is not None
+            for nb in migrate_buckets(self.kv_migrate_burst):
+                idx = jnp.asarray(np.full((nb,), -1, dtype=np.int32))
+                gather_pages(self._k_pages, self._v_pages, idx,
+                             self._k_scales, self._v_scales)
+                (self._k_pages, self._v_pages, self._k_scales,
+                 self._v_scales) = scatter_pages(
+                    self._k_pages, self._v_pages, idx,
+                    jnp.zeros((L, n_kv, nb, ps, hd), self._k_pages.dtype),
+                    self._k_scales, self._v_scales,
+                    v_vals=jnp.zeros((L, n_kv, nb, ps, hd), self._v_pages.dtype),
+                    ks_vals=(jnp.zeros((L, n_kv, nb), jnp.float32)
+                             if quant else None),
+                    vs_vals=(jnp.zeros((L, n_kv, nb), jnp.float32)
+                             if quant else None),
+                )
+                if self._draft_enabled:
+                    dL = self.draft_cfg.num_layers
+                    dn, dhd = self.draft_cfg.num_kv_heads, self.draft_cfg.head_dim
+                    gather_pages(self._dk_pages, self._dv_pages, idx)
+                    self._dk_pages, self._dv_pages, _, _ = scatter_pages(
+                        self._dk_pages, self._dv_pages, idx,
+                        jnp.zeros((dL, dn, nb, ps, dhd), self._dk_pages.dtype),
+                        v_vals=jnp.zeros((dL, dn, nb, ps, dhd),
+                                         self._dv_pages.dtype),
+                    )
         logger.info("engine warmup complete (%d prefill row buckets)", len(buckets))
 
     def generate(

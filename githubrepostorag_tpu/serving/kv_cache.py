@@ -182,7 +182,9 @@ class PageAllocator:
         self._free.extend(pages)
 
     def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0) -> bool:
-        """Interface parity with PrefixCachingAllocator (no cache here)."""
+        """Interface parity with PrefixCachingAllocator (no cache here, so
+        ``hashes`` — duplicates included — never changes the answer, and
+        ``need=0`` trivially admits)."""
         return self.free_count + extra_free >= need
 
     def releasable_count(self, pages: list[int]) -> int:
@@ -280,12 +282,17 @@ class PrefixCachingAllocator:
         """Would ``share(hashes)`` + ``allocate(need - matched)`` succeed
         right now (plus ``extra_free`` pages the caller could recycle first)?
         Matched pages that are parked in the LRU must not double-count as
-        allocatable free pages — sharing removes them from the LRU."""
+        allocatable free pages — sharing removes them from the LRU.  A page
+        can match at most ONCE per admission (degenerate prompts can repeat
+        a chain hash; a block table may list a page twice, but each listing
+        is a separate refcount, i.e. a separate claim on capacity)."""
         matched = parked = 0
+        seen: set[int] = set()
         for h in hashes:
             page = self._hash_to_page.get(h)
-            if page is None:
+            if page is None or page in seen:
                 break
+            seen.add(page)
             matched += 1
             if page in self._lru:
                 parked += 1
@@ -294,12 +301,17 @@ class PrefixCachingAllocator:
 
     def share(self, hashes: list[bytes]) -> list[int]:
         """Claim the longest cached run matching ``hashes``: refcounts bump,
-        parked pages leave the LRU.  Returns the shared pages in order."""
+        parked pages leave the LRU.  Returns the shared pages in order.
+        Mirrors ``can_admit``: the run stops at the first hash that would
+        re-claim a page already shared by THIS call, so duplicate chain
+        hashes never hand one physical page out twice per admission."""
         out: list[int] = []
+        seen: set[int] = set()
         for h in hashes:
             page = self._hash_to_page.get(h)
-            if page is None:
+            if page is None or page in seen:
                 break
+            seen.add(page)
             if page in self._lru:
                 del self._lru[page]
             self._rc[page] = self._rc.get(page, 0) + 1
@@ -314,6 +326,242 @@ class PrefixCachingAllocator:
             return
         self._hash_to_page[h] = page
         self._page_to_hash[page] = h
+
+
+class TieredPageAllocator(PrefixCachingAllocator):
+    """Prefix-caching allocator with a host-RAM swap tier behind the
+    indirection table.
+
+    Residency of a registered chain hash:
+
+    * **device** — in ``_hash_to_page`` only (the base-class maps).
+    * **host** — in ``_host`` only: the page content lives in host RAM as
+      an opaque payload the engine gathered off-device.  ``share`` extends
+      the cached run through host hits by allocating a device page and
+      staging a fault-in scatter the engine dispatches before any program
+      that could read the page.
+    * **saved** (both) — device copy + host copy.  ``allocate`` reclaims
+      saved parked pages FIRST: dropping their device copy costs nothing
+      because the hash stays servable from host RAM.
+    * **in-flight** — in ``_wb_inflight``: a writeback gather is dispatched
+      but its DMA hasn't landed (``complete_writeback`` pending).  Counts
+      as saved for reclaim — the gather snapshot was taken at dispatch and
+      registered pages are immutable, so the payload is already correct.
+
+    Page indices the allocator hands out are plain device pages — the
+    block-table/indirection machinery upstream is untouched; tiering is
+    purely an allocator + step-boundary-migration concern.  Only REGISTERED
+    refcount-0 pages ever move tiers: refcounted pages are pinned on device
+    (they never enter the LRU), so an active row's KV can't be swapped out
+    from under it.
+
+    ``_claims`` tracks chain hashes an admitted-but-unregistered prefill is
+    about to publish, letting the engine hold an identical-prefix follower
+    for one registration instead of duplicating the leader's whole
+    footprint (cross-user dedup under oversubscription).
+    """
+
+    def __init__(
+        self, num_pages: int, host_pool_pages: int = 0, migrate_burst: int = 8
+    ) -> None:
+        super().__init__(num_pages)
+        # <= 0 means unbounded (the engine always passes a positive cap)
+        self.host_pool_pages = host_pool_pages
+        self.migrate_burst = max(1, migrate_burst)
+        # hash -> opaque page payload, least-recently-used first
+        self._host: dict[bytes, object] = {}
+        self._wb_inflight: set[bytes] = set()
+        # hash -> count of admitted prefills that will register it
+        self._claims: dict[bytes, int] = {}
+        # (device page, payload) scatters staged by share(); the engine
+        # drains via fault_in() and dispatches before dependent programs
+        self._staged_faults: list[tuple[int, object]] = []
+        # cumulative stats (async engine exports deltas)
+        self.fault_ins = 0  # host->device re-admissions
+        self.writebacks = 0  # device->host saves completed
+        self.dedup_hits = 0  # share() hits on pages other requests hold
+        self.host_evictions = 0  # host-LRU payloads dropped at capacity
+        self.tier_drops = 0  # device evictions that cost nothing (saved)
+
+    @property
+    def host_pages(self) -> int:
+        return len(self._host)
+
+    @property
+    def plain_free_count(self) -> int:
+        """Free pages available without evicting anything from the cache."""
+        return len(self._free)
+
+    # ------------------------------------------------------------ device --
+
+    def allocate(self, n: int) -> list[int]:
+        if n > self.free_count:
+            raise OutOfPages(f"need {n} pages, {self.free_count} free")
+        out: list[int] = []
+        for _ in range(n):
+            if self._free:
+                page = self._free.pop()
+            else:
+                page = self._pick_eviction()
+                del self._lru[page]
+                h = self._page_to_hash.pop(page)
+                del self._hash_to_page[h]
+                if h in self._host or h in self._wb_inflight:
+                    self.tier_drops += 1
+            self._rc[page] = 1
+            out.append(page)
+        return out
+
+    def _pick_eviction(self) -> int:
+        # prefer the coldest SAVED parked page — its hash survives in host
+        # RAM, so the device copy is free to drop; fall back to the coldest
+        # overall (the hash is lost, exactly the base-class economics)
+        for page in self._lru:
+            h = self._page_to_hash[page]
+            if h in self._host or h in self._wb_inflight:
+                return page
+        return next(iter(self._lru))
+
+    # -------------------------------------------------------- prefix API --
+
+    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0) -> bool:
+        """Host-resident hash hits count as free-able capacity: a host hit
+        still consumes a device page (the fault-in target, included in
+        ``need``) but extends the shareable run instead of breaking it, and
+        saved parked pages reclaim at zero cache cost.  Device-matched
+        pages reduce the allocation need as in the base class (with the
+        same one-match-per-page rule)."""
+        matched = parked = 0
+        seen: set[int] = set()
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is not None:
+                if page in seen:
+                    break
+                seen.add(page)
+                matched += 1
+                if page in self._lru:
+                    parked += 1
+                continue
+            if h in self._host:
+                continue  # fault-in target: needs a page, run continues
+            break
+        avail = len(self._free) + len(self._lru) - parked + extra_free
+        return avail >= need - matched
+
+    def share(self, hashes: list[bytes]) -> list[int]:
+        """Claim the longest run servable from EITHER tier.  Device hits
+        bump refcounts as in the base class; host hits allocate a fresh
+        device page, stage its fault-in scatter, and re-register the hash
+        immediately so concurrent claimants of the same prefix resolve to
+        the one faulting page (paying a single migration)."""
+        out: list[int] = []
+        seen: set[int] = set()
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is not None:
+                if page in seen:
+                    break
+                seen.add(page)
+                if self._rc.get(page, 0) > 0:
+                    self.dedup_hits += 1
+                if page in self._lru:
+                    del self._lru[page]
+                self._rc[page] = self._rc.get(page, 0) + 1
+                out.append(page)
+                continue
+            payload = self._host.get(h)
+            if payload is None:
+                break
+            try:
+                [page] = self.allocate(1)
+            except OutOfPages:
+                break
+            # refresh host-LRU recency; the payload stays (dual residency:
+            # the device copy is droppable at zero cost from here on)
+            del self._host[h]
+            self._host[h] = payload
+            self._hash_to_page[h] = page
+            self._page_to_hash[page] = h
+            self._staged_faults.append((page, payload))
+            self.fault_ins += 1
+            seen.add(page)
+            out.append(page)
+        return out
+
+    # --------------------------------------------------------- migration --
+
+    def evict(self, max_n: int) -> list[tuple[int, bytes]]:
+        """Plan one writeback burst: up to ``max_n`` of the coldest parked
+        pages not yet saved to host (device→host is a residency transition,
+        NOT a release — the pages stay device-resident and shareable until
+        ``allocate`` reclaims them).  Marks each hash in-flight; the engine
+        gathers the page contents and calls ``complete_writeback`` once the
+        DMA lands.  Refcounted pages never appear (not in the LRU)."""
+        out: list[tuple[int, bytes]] = []
+        cap = self.host_pool_pages
+        for page in self._lru:
+            if len(out) >= max_n:
+                break
+            h = self._page_to_hash[page]
+            if h in self._host or h in self._wb_inflight:
+                continue
+            if cap > 0 and len(self._host) + len(self._wb_inflight) >= cap:
+                break
+            self._wb_inflight.add(h)
+            out.append((page, h))
+        return out
+
+    def complete_writeback(self, h: bytes, payload: object) -> None:
+        """Store a landed writeback payload under its chain hash.  Content
+        addressing makes this unconditionally safe: even if the device page
+        was reclaimed (or re-registered to a twin) meanwhile, the payload
+        IS the content every holder of ``h`` expects."""
+        self._wb_inflight.discard(h)
+        self._host[h] = payload
+        self.writebacks += 1
+        if self.host_pool_pages > 0:
+            while len(self._host) > self.host_pool_pages:
+                cold = next(iter(self._host))
+                del self._host[cold]
+                self.host_evictions += 1
+
+    def fault_in(self) -> list[tuple[int, object]]:
+        """Drain the staged host→device transitions for this step's scatter
+        dispatch.  The caller MUST dispatch these before any program that
+        could read the target pages (device program order then guarantees
+        the faulted content is visible — no host sync needed)."""
+        staged, self._staged_faults = self._staged_faults, []
+        return staged
+
+    # ------------------------------------------------------ pending claims --
+
+    def claim(self, hashes: list[bytes]) -> None:
+        """Record that an admitted prefill will register ``hashes``."""
+        for h in hashes:
+            self._claims[h] = self._claims.get(h, 0) + 1
+
+    def unclaim(self, hashes: list[bytes]) -> None:
+        for h in hashes:
+            n = self._claims.get(h, 0) - 1
+            if n > 0:
+                self._claims[h] = n
+            else:
+                self._claims.pop(h, None)
+
+    def pending_claim_pages(self, hashes: list[bytes]) -> int:
+        """How many pages of this prompt's shareable run are mid-prefill on
+        another row right now (claimed, not yet registered).  >0 tells the
+        scheduler a one-registration wait will dedup that many pages."""
+        n = 0
+        for h in hashes:
+            if self._hash_to_page.get(h) is not None or h in self._host:
+                continue  # already servable — nothing to wait for
+            if self._claims.get(h, 0) > 0:
+                n += 1
+            else:
+                break
+        return n
 
 
 def pages_needed(num_tokens: int, page_size: int) -> int:

@@ -19,6 +19,14 @@ python -m tools.tpulint githubrepostorag_tpu tests \
 echo "== /debug/traces schema =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_traces_schema.py
 
+echo "== kv-tier oversubscription A/B (CPU-tiny) =="
+# tiered vs device-only pool at equal HBM budget: bench_kv_tier_pair
+# asserts >=1.5x admitted concurrency, token-identical outputs, and zero
+# live-traffic XLA recompiles — a failed gate fails the bench exit code.
+# BENCH_ONLY keeps the run single-scenario and leaves the committed
+# BENCH_SUMMARY.json untouched; the artifact lands in artifacts/.
+BENCH_ONLY=kv_tier JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -169,6 +169,14 @@ def build_table(records: list[dict], driver_name: str,
         ("Draft-model spec TTFT p95, plain / spec (CPU A/B)",
          ["spec_conc8_cpu_ttft_p95_ms_plain",
           "spec_conc8_cpu_ttft_p95_ms_spec"], "ms"),
+        ("KV tiering conc128 peak admitted rows, device-only / tiered (CPU A/B)",
+         ["kv_tier_conc128_cpu_peak_concurrency_device",
+          "kv_tier_conc128_cpu_peak_concurrency_tiered"], "rows"),
+        ("KV tiering admitted-concurrency ratio at equal HBM (CPU A/B)",
+         ["kv_tier_conc128_cpu_admit_ratio"], "×"),
+        ("KV tiering TTFT p95, device-only / tiered (CPU A/B)",
+         ["kv_tier_conc128_cpu_ttft_p95_ms_device",
+          "kv_tier_conc128_cpu_ttft_p95_ms_tiered"], "ms"),
         ("Qwen2-MoE 16-expert decode, bs=8 (beyond-reference)",
          ["decode_tok_s_per_chip_qwen2-moe-16e_bs8"], "tok/s"),
         ("Qwen2-MoE 16-expert INT8 decode, bs=8",
@@ -193,7 +201,8 @@ def render(root: pathlib.Path = ROOT, driver_name: str | None = None) -> str:
     # (BENCH_retrieval_cpu.json, written by bench.py's CPU branch) carries
     # metrics a TPU-run BENCH_SUMMARY.json doesn't — appended AFTER the
     # summary records so the committed A/B wins any same-name collision
-    for artifact in ("BENCH_retrieval_cpu.json", "BENCH_spec_cpu.json"):
+    for artifact in ("BENCH_retrieval_cpu.json", "BENCH_spec_cpu.json",
+                     "BENCH_kv_tier_cpu.json"):
         path = root / artifact
         if path.exists():
             records += json.loads(path.read_text())["records"]

@@ -1,0 +1,3 @@
+"""WPA004 tier negative: evict/fault_in round trip done right — the
+handle stays owned across tier moves and still reaches exactly one
+release."""

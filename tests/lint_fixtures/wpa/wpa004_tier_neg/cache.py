@@ -1,0 +1,16 @@
+from wpa004_tier_neg.pool import PagePool
+
+
+class Cache:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def rebalance(self, req, n):
+        pages = self.pool.allocate(n)
+        self.pool.evict(pages)  # parked on the host tier, still owned
+        self.pool.fault_in(pages)  # back to device, still the same handle
+        req.pages = pages
+        return req
+
+    def teardown(self, req):
+        self.pool.release(req.pages)

@@ -1,0 +1,3 @@
+"""WPA004 tier positive: a freed handle passed to a tier migration
+(use-after-release) and a handle parked on the host tier then dropped —
+evict() moves pages, it does not release them."""

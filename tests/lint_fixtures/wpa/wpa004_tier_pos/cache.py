@@ -1,0 +1,16 @@
+from wpa004_tier_pos.pool import PagePool
+
+
+class Cache:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def evict_after_free(self):
+        pages = self.pool.allocate(2)
+        self.pool.release(pages)
+        self.pool.evict(pages)  # use-after-release: pages already freed
+
+    def park(self, n):
+        pages = self.pool.allocate(n)
+        self.pool.evict(pages)
+        return None  # evict moved pages to host, never released: leak

@@ -1,0 +1,24 @@
+class OutOfPages(Exception):
+    pass
+
+
+class PagePool:
+    def __init__(self, n=8):
+        self.free = list(range(n))
+        self.host = []
+
+    def allocate(self, n):
+        if n > len(self.free):
+            raise OutOfPages()
+        out, rest = self.free[:n], self.free[n:]
+        self.free = rest
+        return out
+
+    def evict(self, pages):
+        self.host.extend(pages)
+
+    def fault_in(self, pages):
+        self.host = [p for p in self.host if p not in pages]
+
+    def release(self, pages):
+        self.free.extend(pages)

@@ -1,0 +1,2 @@
+"""WPA004 tier suppressed: the park-on-host leak silenced with a
+justified directive at the return site."""

@@ -1,0 +1,12 @@
+from wpa004_tier_sup.pool import PagePool
+
+
+class Cache:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def park(self, n):
+        pages = self.pool.allocate(n)
+        self.pool.evict(pages)
+        # tpulint: disable=WPA004 -- warm-pool prefill: the host tier owns parked pages until the next generation sweep releases them in bulk
+        return None

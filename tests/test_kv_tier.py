@@ -1,0 +1,358 @@
+"""KV page tiering: host-RAM swap tier + cross-user prefix-page dedup.
+
+Covers: TieredPageAllocator residency mechanics (save/evict/fault-in,
+rc-pinning, dual residency, claim dedup), the can_admit duplicate-hash and
+need=0 edges on every allocator, engine round trips with token-identical
+outputs across eviction + fault-in, deadline-reap accounting over both
+tiers, and the zero-live-recompile discipline across mixed
+resident/swapped traffic.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.serving import Engine, SamplingParams
+from githubrepostorag_tpu.serving.kv_cache import (
+    OutOfPages,
+    PageAllocator,
+    PrefixCachingAllocator,
+    TieredPageAllocator,
+    page_hashes,
+)
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from githubrepostorag_tpu.models.hf_loader import config_from_hf, params_from_state_dict
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg.to_dict())
+    params = params_from_state_dict(model.state_dict(), cfg)
+    return model, params, cfg
+
+
+def _engine(params, cfg, **kw):
+    # deliberately tiny device pool: 12 pages of 4 tokens, so a single
+    # 40-token filler oversubscribes it and forces tier traffic
+    defaults = dict(
+        max_num_seqs=2, num_pages=12, page_size=4, max_seq_len=64,
+        prefill_chunk=16, kv_dtype=jnp.float32, decode_burst=4,
+        kv_tier="on", kv_host_pool_pages=32,
+    )
+    defaults.update(kw)
+    return Engine(params, cfg, **defaults)
+
+
+def _payload():
+    # opaque page payload stand-in; the allocator never looks inside
+    return (None, None, None, None, None, None)
+
+
+def _saved_parked(al: TieredPageAllocator, hashes):
+    """Park ``hashes``' pages and complete their writebacks (saved state)."""
+    for page, h in al.evict(len(hashes)):
+        al.complete_writeback(h, _payload())
+
+
+# ----------------------------------------------------- can_admit edges --
+
+
+def test_plain_allocator_can_admit_ignores_hashes_and_need_zero():
+    al = PageAllocator(2)
+    h = page_hashes(list(range(8)), 4)
+    assert al.can_admit([], 0)
+    assert al.can_admit(h + h, 2)  # duplicates never change the answer
+    al.allocate(2)
+    assert al.can_admit(h + h, 0)  # need=0 trivially admits, even exhausted
+    assert not al.can_admit(h + h, 1)
+
+
+@pytest.mark.parametrize("cls", [PrefixCachingAllocator, TieredPageAllocator])
+def test_prefix_can_admit_duplicate_hash_matches_once(cls):
+    """A degenerate prompt can repeat a chain hash; the matched run must
+    stop at the first re-claim — double-counting the page would admit a
+    request share() cannot actually back."""
+    al = cls(2)
+    [h0] = page_hashes(list(range(4)), 4)
+    [page] = al.allocate(1)
+    al.register(h0, page)
+    al.release([page])  # parked; 1 plain-free page remains
+    assert al.can_admit([h0, h0], 2)  # 1 match + 1 fresh: fits
+    assert not al.can_admit([h0, h0], 3)  # dup must NOT count as 2 matches
+    assert al.share([h0, h0]) == [page]  # and share agrees: one claim only
+    al.release([page])
+
+
+@pytest.mark.parametrize("cls", [PrefixCachingAllocator, TieredPageAllocator])
+def test_prefix_can_admit_need_zero(cls):
+    al = cls(1)
+    al.allocate(1)  # pool exhausted
+    assert al.can_admit([], 0)
+    assert not al.can_admit([], 1)
+
+
+# ------------------------------------------------- tiered allocator unit --
+
+
+def test_tiered_host_hit_extends_admittable_run():
+    """A host-resident hash consumes a device page (fault-in target) but
+    keeps the shareable run going instead of breaking it."""
+    al = TieredPageAllocator(4, host_pool_pages=8)
+    h = page_hashes(list(range(8)), 4)  # 2-page chain
+    pages = al.allocate(2)
+    al.register(h[0], pages[0])
+    al.register(h[1], pages[1])
+    al.release(pages)
+    _saved_parked(al, h)
+    # drop both device copies: saved pages reclaim at zero cache cost
+    held = al.allocate(4)
+    assert al.tier_drops == 2 and al.host_pages == 2
+    al.release(held)
+    # both pages now host-only; the run still matches end to end
+    assert al.can_admit(h, 4)  # 2 fault-in targets + 2 fresh = 4 free
+    assert not al.can_admit(h, 5)
+    shared = al.share(h)
+    assert len(shared) == 2 and al.fault_ins == 2
+    assert len(al.fault_in()) == 2  # both staged scatters drain once
+    al.release(shared)
+
+
+def test_rc_pinned_pages_never_evict():
+    """A page another request still shares (rc>0) is pinned on device: it
+    never enters the LRU, so neither evict() nor allocate() can take it."""
+    al = TieredPageAllocator(2, host_pool_pages=8)
+    [h0] = page_hashes(list(range(4)), 4)
+    [page] = al.allocate(1)
+    al.register(h0, page)
+    assert al.share([h0]) == [page]  # rc 2
+    al.release([page])  # rc 1: still live, still pinned
+    assert al.evict(8) == []
+    [other] = al.allocate(1)
+    assert other != page  # the free page, not the pinned one
+    with pytest.raises(OutOfPages):
+        al.allocate(1)  # pinned page is not reclaimable
+    al.release([other])
+    al.release([page])  # rc 0: parked, NOW evictable
+    assert [p for p, _ in al.evict(8)] == [page]
+
+
+def test_refault_is_paid_once_for_n_claimants():
+    """share() re-registers a faulting hash immediately, so N concurrent
+    claimants of an evicted prefix resolve to the one faulting page: one
+    migration, N-1 dedup hits."""
+    al = TieredPageAllocator(6, host_pool_pages=8)
+    h = page_hashes(list(range(8)), 4)
+    pages = al.allocate(2)
+    al.register(h[0], pages[0])
+    al.register(h[1], pages[1])
+    al.release(pages)
+    _saved_parked(al, h)
+    held = al.allocate(6)  # flush device copies (saved -> host-only)
+    al.release(held)
+    claims = [al.share(h) for _ in range(3)]
+    assert al.fault_ins == 2  # first claimant faults the 2-page chain...
+    assert all(c == claims[0] for c in claims)  # ...everyone gets its pages
+    assert al.dedup_hits == 4  # 2 pages x 2 followers ride the same fault
+    assert len(al.fault_in()) == 2  # one staged scatter per page, total
+    for c in claims:
+        al.release(c)
+    assert al.free_count == al.num_pages
+
+
+def test_writeback_respects_host_cap_and_lru():
+    al = TieredPageAllocator(8, host_pool_pages=2)
+    h = page_hashes(list(range(16)), 4)  # 4-page chain
+    pages = al.allocate(4)
+    for hh, p in zip(h, pages):
+        al.register(hh, p)
+    al.release(pages)
+    plan = al.evict(8)
+    assert len(plan) == 2  # host cap bounds the in-flight set
+    for page, hh in plan:
+        al.complete_writeback(hh, _payload())
+    assert al.evict(8) == []  # at cap: nothing further to save
+    assert al.host_pages == 2 and al.writebacks == 2
+
+
+def test_claim_dedup_accounting():
+    al = TieredPageAllocator(4)
+    h = page_hashes(list(range(12)), 4)  # 3-page chain
+    al.claim(h)
+    al.claim(h[:1])
+    assert al.pending_claim_pages(h) == 3
+    al.unclaim(h[:1])
+    assert al.pending_claim_pages(h) == 3  # first hash still claimed once
+    al.unclaim(h)
+    assert al.pending_claim_pages(h) == 0
+    # a servable hash is never "pending" — nothing to wait for
+    [page] = al.allocate(1)
+    al.register(h[0], page)
+    al.claim(h[1:])
+    assert al.pending_claim_pages(h) == 2
+    al.release([page])
+
+
+# ---------------------------------------------------------------- engine --
+
+
+def test_evicted_prefix_faults_in_token_identical(tiny):
+    """The tentpole round trip: a prefix registered, written back to host,
+    its device copies reclaimed by an oversubscribing filler, then
+    re-admitted via fault-in — outputs stay token-identical to an untiered
+    engine and the pool balances."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, size=24).tolist()  # 6 pages
+    filler = rng.integers(0, cfg.vocab_size, size=40).tolist()  # 10 pages
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=(),
+                        repetition_penalty=1.2)
+
+    ref = _engine(params, cfg, prefix_caching=False, kv_tier="off",
+                  kv_host_pool_pages=0)
+    expected = ref.generate([prompt], sp)[0].output_tokens
+
+    eng = _engine(params, cfg)
+    assert eng.generate([prompt], sp)[0].output_tokens == expected
+    eng.flush_kv_migrations()  # save the parked prefix to the host tier
+    wb = eng._allocator.writebacks
+    assert wb >= 5  # (24-1)//4 registered pages all reached host RAM
+    eng.generate([filler], sp)  # 11-page footprint: drops saved copies
+    assert eng._allocator.tier_drops > 0
+    res = eng.generate([prompt], sp)[0]
+    assert res.output_tokens == expected  # faulted KV is byte-faithful
+    assert res.faulted_pages > 0
+    assert eng._allocator.fault_ins == res.faulted_pages
+    assert eng.kv_fault_dispatches >= 1
+    assert eng._allocator.free_count == eng._allocator.num_pages
+    assert not eng.has_work()
+
+
+def test_deadline_reap_frees_both_tiers(tiny):
+    """A reaped request whose prefix just faulted in must return every
+    device page and drop its pending claims; the host copies stay behind
+    as cache (they are content, not capacity)."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, size=24).tolist()
+    filler = rng.integers(0, cfg.vocab_size, size=40).tolist()
+    sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+
+    # chunk smaller than the un-cached tail, so the reap lands while the
+    # request is still mid-prefill and still HOLDS registration claims
+    eng = _engine(params, cfg, prefill_chunk=8)
+    eng.generate([prompt], sp)
+    eng.flush_kv_migrations()
+    eng.generate([filler], sp)  # push the prefix to host-only residency
+    # re-admit with a fresh tail so the admission also CLAIMS unregistered
+    # hashes (the cross-user dedup path) before the reap hits
+    tail = rng.integers(0, cfg.vocab_size, size=16).tolist()
+    sp2 = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    rid = eng.add_request(prompt + tail, sp2,
+                          deadline_s=time.monotonic() + 60.0)
+    req = eng._requests[rid]
+    eng.step()  # admits + dispatches the fault-in scatters
+    assert req.faulted_pages > 0
+    assert req.claimed_hashes  # the new tail's pages are claimed
+    req.deadline_ts = time.monotonic() - 1.0
+    finished = []
+    while eng.has_work():
+        finished.extend(eng.step())
+    assert [r.finish_reason for r in finished] == ["deadline"]
+    assert eng._allocator.free_count == eng._allocator.num_pages
+    assert eng._allocator._claims == {}  # reap unclaimed the tail hashes
+    assert eng._allocator._staged_faults == []
+    assert eng._allocator.host_pages > 0  # the cache itself survives
+
+
+def test_dedup_hold_waits_for_inflight_twin(tiny):
+    """An identical-prefix follower admitted while the leader is still
+    prefilling must HOLD (one registration dedups its whole prefix) rather
+    than duplicate the footprint — and both must finish correct."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, cfg.vocab_size, size=32).tolist()  # 8 pages
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    # pool fits the leader (9 pages) but not two full footprints (18)
+    eng = _engine(params, cfg, num_pages=12, prefill_chunk=8)
+    results = eng.generate([prompt, prompt], sp)
+    assert results[0].output_tokens == results[1].output_tokens
+    assert eng.dedup_holds > 0  # the follower waited instead of ballooning
+    assert eng._allocator.free_count == eng._allocator.num_pages
+
+
+def test_zero_recompiles_across_mixed_resident_swapped_traffic(tiny):
+    """Migration must ride the warmup-precompiled gather/scatter buckets:
+    a traffic mix spanning resident hits, writebacks, tier drops, and
+    fault-ins compiles ZERO new XLA programs after warmup."""
+    from githubrepostorag_tpu.obs.engine_profile import CompileWatchdog
+
+    _, params, cfg = tiny
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, cfg.vocab_size, size=24).tolist()
+    filler = rng.integers(0, cfg.vocab_size, size=40).tolist()
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+
+    eng = _engine(params, cfg)
+    eng.warmup()
+    wd = CompileWatchdog()
+    wd.resync()
+    eng.generate([prompt], sp)  # cold prefill
+    eng.flush_kv_migrations()  # writeback burst (gather)
+    eng.generate([prompt], sp)  # resident cache hit
+    eng.generate([filler], sp)  # oversubscribe: tier drops
+    eng.flush_kv_migrations()
+    eng.generate([prompt], sp)  # fault-in burst (scatter)
+    assert eng._allocator.writebacks > 0
+    assert eng._allocator.fault_ins > 0
+    assert wd.sample() == 0
+
+
+def test_scatter_pages_padding_never_touches_the_last_page():
+    """Regression: a non-full migration burst pads its index vector with
+    -1, and jnp normalizes negative indices (-1 -> P-1) BEFORE the
+    mode="drop" out-of-bounds check — an unfixed scatter zeroes the pool's
+    last page on every padded fault-in burst, silently corrupting whatever
+    request owns it (caught live: a 7-page fault-in bucketed to 8 garbled
+    a re-admitted prefix's output through the serving API)."""
+    from githubrepostorag_tpu.ops.page_migration import (
+        gather_pages, scatter_pages)
+
+    L, n_kv, P, ps, hd, nb = 2, 2, 6, 4, 8, 4
+    rng = np.random.default_rng(17)
+    k0 = jnp.asarray(rng.standard_normal((L, n_kv, P, ps, hd)), jnp.float32)
+    v0 = jnp.asarray(rng.standard_normal((L, n_kv, P, ps, hd)), jnp.float32)
+    payload_k = jnp.asarray(rng.standard_normal((L, n_kv, nb, ps, hd)),
+                            jnp.float32)
+    payload_v = jnp.asarray(rng.standard_normal((L, n_kv, nb, ps, hd)),
+                            jnp.float32)
+    idx = jnp.asarray(np.array([2, -1, -1, -1], np.int32))
+
+    k1, v1, _, _ = scatter_pages(k0.copy(), v0.copy(), idx, payload_k,
+                                 v_vals=payload_v)
+    # the one real row landed...
+    np.testing.assert_array_equal(k1[:, :, 2], payload_k[:, :, 0])
+    np.testing.assert_array_equal(v1[:, :, 2], payload_v[:, :, 0])
+    # ...and every other page — the LAST one above all — is untouched
+    for p in [0, 1, 3, 4, 5]:
+        np.testing.assert_array_equal(k1[:, :, p], k0[:, :, p])
+        np.testing.assert_array_equal(v1[:, :, p], v0[:, :, p])
+
+    # gather side: padding rows may hold anything, but the real rows must
+    # read back exactly what the scatter committed
+    gk, gv, _, _ = gather_pages(k1, v1, idx)
+    np.testing.assert_array_equal(gk[:, :, 0], payload_k[:, :, 0])
+    np.testing.assert_array_equal(gv[:, :, 0], payload_v[:, :, 0])

@@ -117,6 +117,33 @@ def test_wpa004_positive_catches_both_leak_and_double_free():
     assert any("double-free" in m for m in messages), messages
 
 
+# KV tiering extends the WPA004 alphabet: evict()/fault_in() move pages
+# between the device and host tiers WITHOUT changing ownership, so the
+# checker must (a) not treat a tier move as a release — parking pages on
+# the host and dropping the handle is still a leak — and (b) flag a tier
+# move applied to a handle whose pages were already released.
+
+def test_wpa004_tier_positive_catches_use_after_release_and_leak():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_tier_pos"])
+    messages = [f.message for f in findings if f.rule == "WPA004"]
+    assert any("use-after-release" in m for m in messages), messages
+    # evict() must NOT count as a release: the parked handle still leaks
+    assert any("leak" in m for m in messages), messages
+
+
+def test_wpa004_tier_negative_is_silent():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_tier_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_wpa004_tier_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_tier_sup"])
+    hits = [f for f in findings if f.rule == "WPA004"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
 def test_domain_annotation_seeds_inference(tmp_path):
     # `# tpulint: domain=event_loop` pins a sync helper to the loop even
     # with no call edge proving it — the annotation is the seed

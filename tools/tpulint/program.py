@@ -869,6 +869,12 @@ def check_wpa003(program: Program) -> Iterator[ProgramFinding]:
 
 _ALLOC_METHODS = {"allocate", "share"}
 _RELEASE_METHODS = {"release", "recycle", "free"}
+# tier migrations move pages between device HBM and the host swap tier:
+# the handle's ownership does NOT change (an evicted page is still owned
+# and must still be released), so these are typestate-preserving
+# transitions — but applying one to an already-released handle is
+# use-after-free of pool state
+_TIER_METHODS = {"evict", "fault_in"}
 _POOLISH_RE = re.compile(r"alloc|pool|page", re.IGNORECASE)
 
 OWNED, MAYBE, RELEASED, ESCAPED = "owned", "maybe", "released", "escaped"
@@ -896,7 +902,7 @@ class _PoolOps:
         if d is None:
             return None
         last = d.rsplit(".", 1)[-1]
-        if last not in _ALLOC_METHODS | _RELEASE_METHODS:
+        if last not in _ALLOC_METHODS | _RELEASE_METHODS | _TIER_METHODS:
             return None
         resolved = self.program._resolve_dotted_call(d, self.fn)
         is_pool = any(m.cls is not None and m.cls.qualname in self.pools
@@ -906,7 +912,11 @@ class _PoolOps:
             is_pool = bool(_POOLISH_RE.search(receiver))
         if not is_pool:
             return None
-        return "alloc" if last in _ALLOC_METHODS else "release"
+        if last in _ALLOC_METHODS:
+            return "alloc"
+        if last in _TIER_METHODS:
+            return "tier"
+        return "release"
 
 
 @dataclass
@@ -960,6 +970,22 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
             else:
                 res.release_attrs.update(attrs_read(arg))
 
+    def handle_tier(call: ast.Call, env: dict[str, str]) -> None:
+        # evict()/fault_in() change a page's residency tier, not its
+        # ownership: OWNED handles stay OWNED (a leak still fires if
+        # they never release), but a RELEASED handle passed to a tier
+        # move touches pool state for pages that may already be reused
+        for arg in call.args:
+            if isinstance(arg, ast.Name) and env.get(arg.id) == RELEASED:
+                res.findings.append((
+                    call.lineno, call.col_offset,
+                    f"use-after-release: '{arg.id}' passed to a tier "
+                    f"migration in '{fn.qualname}' after its pages were "
+                    f"released — evict/fault_in move live pages between "
+                    f"tiers; a freed handle's pages may already belong "
+                    f"to another request",
+                ))
+
     def handle_calls(stmt: ast.AST, env: dict[str, str]) -> None:
         """Release calls + owned-var escapes through arbitrary calls."""
         for node in ast.walk(stmt):
@@ -968,6 +994,8 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
             kind = ops.kind_of(node)
             if kind == "release":
                 handle_release(node, env)
+            elif kind == "tier":
+                handle_tier(node, env)
             elif kind is None:
                 for name in names_read(node):
                     if env.get(name) in {OWNED, MAYBE}:
