@@ -8,13 +8,23 @@ echo "== tpulint =="
 make lint
 
 echo "== tpulint whole-program JSON artifact =="
-# machine-readable findings (incl. suppressed + baselined) for CI consumers;
-# the baseline gate itself already ran inside `make lint`
+# machine-readable findings (schema v3: incl. suppressed + baselined and
+# per-finding SHP001 taint_chain witnesses) for CI consumers; the baseline
+# gate itself already ran inside `make lint`, so an unbaselined SHP/WPA/TPU
+# finding has already failed the build by this point
 mkdir -p artifacts
 python -m tools.tpulint githubrepostorag_tpu tests \
     --exclude tests/lint_fixtures --baseline tools/tpulint/baseline.json \
     --format json > artifacts/tpulint.json \
     || { echo "tpulint JSON pass failed (exit $?)"; exit 1; }
+
+echo "== tpulint SARIF artifact =="
+# SARIF 2.1.0 for code-scanning upload; suppressions ride along as SARIF
+# suppression records instead of being dropped
+python -m tools.tpulint githubrepostorag_tpu tests \
+    --exclude tests/lint_fixtures --baseline tools/tpulint/baseline.json \
+    --format sarif > artifacts/tpulint.sarif \
+    || { echo "tpulint SARIF pass failed (exit $?)"; exit 1; }
 
 echo "== /debug/traces schema =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_traces_schema.py

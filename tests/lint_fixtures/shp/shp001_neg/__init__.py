@@ -1,0 +1,2 @@
+"""SHP001 negative: the same cross-module flow, but the length passes a
+bucketing barrier before reaching the shape position."""

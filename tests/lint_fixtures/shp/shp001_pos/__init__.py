@@ -1,0 +1,3 @@
+"""SHP001 positive: a request-derived length crosses a module boundary
+and reaches a device allocation with no bucketing barrier — only the
+interprocedural taint pass can see it."""

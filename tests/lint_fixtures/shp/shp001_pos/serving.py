@@ -1,0 +1,7 @@
+from shp001_pos.shapes import pad_batch
+
+
+def handle_batch(requests):
+    # len() of request data is the taint source
+    n = len(requests)
+    return pad_batch(n)

@@ -1,0 +1,7 @@
+import jax.numpy as jnp
+
+
+def pad_batch(rows):
+    # rows is tainted via the caller in serving.py; the shape position
+    # compiles a fresh XLA program for every distinct request count
+    return jnp.zeros((rows, 128), jnp.float32)

@@ -1,0 +1,2 @@
+"""SHP002 negative: the same serving class, but warmup() precompiles the
+jitted callee the hot path dispatches."""

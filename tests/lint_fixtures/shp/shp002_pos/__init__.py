@@ -1,0 +1,3 @@
+"""SHP002 positive: a serving class runs bucketed jit dispatches on its
+hot path but defines no warmup routine — the whole ladder compiles under
+live traffic."""

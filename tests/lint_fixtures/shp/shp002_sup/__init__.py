@@ -1,0 +1,2 @@
+"""SHP002 suppressed: no-warmup class with a justified inline
+suppression on the class line."""

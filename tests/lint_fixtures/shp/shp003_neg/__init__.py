@@ -1,0 +1,2 @@
+"""SHP003 negative: the jit wrapper is memoized on self in __init__ —
+the documented fix."""

@@ -1,0 +1,13 @@
+import jax
+
+
+def _model(x):
+    return x + 1
+
+
+class Engine:
+    def __init__(self):
+        self._model_jit = jax.jit(_model)
+
+    def decode_step(self, x):
+        return self._model_jit(x)

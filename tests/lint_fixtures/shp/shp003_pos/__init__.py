@@ -1,0 +1,2 @@
+"""SHP003 positive: jax.jit constructed inside a per-step method — the
+compile cache dies with the wrapper on every call."""

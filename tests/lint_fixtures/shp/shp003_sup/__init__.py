@@ -1,0 +1,2 @@
+"""SHP003 suppressed: per-step jit construction with a justified inline
+suppression."""

@@ -1,0 +1,2 @@
+"""SHP004 negative: the literal is wrapped in the operand's dtype — the
+documented fix."""

@@ -1,0 +1,3 @@
+"""SHP004 positive: a bare Python literal mixed with a config-dtyped
+operand in a traced argument — the weak type resolves per config and
+keys dtype recompiles."""

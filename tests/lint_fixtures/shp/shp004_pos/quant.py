@@ -1,0 +1,10 @@
+import jax
+
+
+@jax.jit
+def scale_rows(x):
+    return x
+
+
+def apply_scale(x, cfg):
+    return scale_rows(cfg.kv_scale * 0.5)

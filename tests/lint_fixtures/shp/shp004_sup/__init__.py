@@ -1,0 +1,2 @@
+"""SHP004 suppressed: weak-type mix with a justified inline
+suppression."""

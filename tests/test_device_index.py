@@ -24,6 +24,7 @@ from githubrepostorag_tpu.retrieval import (
 )
 from githubrepostorag_tpu.store.base import Doc
 from githubrepostorag_tpu.store.memory import MemoryVectorStore
+from tests.helpers.compile_guard import compile_guard
 
 DIM = 24
 
@@ -174,19 +175,20 @@ def test_warmup_compiles_exact_bucket_set_and_traffic_adds_zero():
     inner.upsert("t", _mk_docs(rng, 50))
     dev = DeviceIndexedStore(inner, k_bucket=16, max_wave=16)
     assert dev.search_program_cache_size() == 0
-    dev.warmup()
-    warmed = dev.search_program_cache_size()
-    assert warmed == 5  # query buckets 1, 2, 4, 8, 16 x one capacity bucket
-    # live traffic: every query count 1..16, filters on and off, k varied
-    for nq in range(1, 17):
-        qs = rng.normal(size=(nq, DIM)).astype(np.float32)
-        dev.search_batch("t", qs, 1 + nq % 16)
-        dev.search_batch("t", qs, 4, [{"repo": "repo1"}] * nq)
-    assert dev.search_program_cache_size() == warmed
-    # upserts that stay inside the capacity bucket also add zero programs
-    dev.upsert("t", [Doc("late", "late doc", {}, rng.normal(size=DIM).astype(np.float32))])
-    dev.search("t", rng.normal(size=DIM).astype(np.float32), 3)
-    assert dev.search_program_cache_size() == warmed
+    # query buckets 1, 2, 4, 8, 16 x one capacity bucket
+    with compile_guard(dev.search_program_cache_size, expect=5,
+                       label="device-index warmup"):
+        dev.warmup()
+    with compile_guard(dev.search_program_cache_size,
+                       label="mixed search traffic"):
+        # live traffic: every query count 1..16, filters on and off, k varied
+        for nq in range(1, 17):
+            qs = rng.normal(size=(nq, DIM)).astype(np.float32)
+            dev.search_batch("t", qs, 1 + nq % 16)
+            dev.search_batch("t", qs, 4, [{"repo": "repo1"}] * nq)
+        # upserts that stay inside the capacity bucket also add zero programs
+        dev.upsert("t", [Doc("late", "late doc", {}, rng.normal(size=DIM).astype(np.float32))])
+        dev.search("t", rng.normal(size=DIM).astype(np.float32), 3)
 
 
 def test_device_path_counted():

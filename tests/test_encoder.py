@@ -78,6 +78,37 @@ def test_jax_text_encoder_batching(tiny_bert):
     np.testing.assert_allclose(vecs[2], single[0], atol=1e-5)
 
 
+def test_encoder_warmup_then_live_traffic_compiles_zero(tiny_bert):
+    """Regression for the tpulint SHP002 finding on JaxBertTextEncoder:
+    the encoder had no warmup, so its whole (rows x length) bucket ladder
+    compiled under live ingest traffic.  warmup() must cover the ladder
+    exactly, and mixed-length mixed-count encode() traffic afterwards must
+    compile ZERO new XLA programs."""
+    from tests.helpers.compile_guard import compile_guard
+
+    _, params, cfg = tiny_bert
+
+    class StubTokenizer:
+        def __call__(self, texts, **kw):
+            cap = kw.get("max_length", 64)
+            return {"input_ids": [[(ord(c) % 250) + 1 for c in t[:cap]] for t in texts]}
+
+    enc = JaxBertTextEncoder(params, cfg, StubTokenizer(), max_length=64,
+                             batch_size=8, e5_prefixes=False)
+    assert enc.length_buckets() == [16, 32, 64]
+    assert enc.row_buckets() == [8]
+    n = enc.warmup()
+    assert n == len(enc.row_buckets()) * len(enc.length_buckets())
+    texts = (["ab"] * 3                      # length bucket 16, partial batch
+             + ["x" * 30] * 8                # length bucket 32, full batch
+             + ["y" * 200] * 5)              # truncated -> length bucket 64
+    with compile_guard(embed._cache_size, label="live encode traffic"):
+        enc.encode(texts)
+        enc.encode(["z"])  # single-text query-shaped call
+    vecs = enc.encode(texts)
+    assert vecs.shape == (len(texts), cfg.hidden_size)
+
+
 def test_hashing_encoder_similarity_tracks_overlap():
     enc = HashingTextEncoder(dim=384)
     vecs = enc.encode([

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from githubrepostorag_tpu.serving import Engine, SamplingParams
+from tests.helpers.compile_guard import compile_guard
 
 
 # --------------------------------------------------------------- op level
@@ -225,17 +226,18 @@ def test_packed_warmup_compiles_exact_shape_set(tiny):
     # and break the exact-count assertion below
     eng = _make_engine(params, cfg, prefill_token_budget=40)
     assert eng.packed_prefill_buckets() == [1, 2, 4]
-    before = forward_paged_packed._cache_size()
-    eng.warmup()
-    after_warmup = forward_paged_packed._cache_size()
-    assert after_warmup - before == len(eng.packed_prefill_buckets())
+    with compile_guard(forward_paged_packed._cache_size,
+                       expect=len(eng.packed_prefill_buckets()),
+                       label="packed warmup"):
+        eng.warmup()
     rng = np.random.default_rng(13)
     sp = SamplingParams(temperature=0.0, max_tokens=4)
     prompts = [rng.integers(0, cfg.vocab_size, size=n).tolist()
                for n in (5, 16, 17, 70, 33)]
-    eng.generate(prompts, sp)
-    eng.generate(prompts, sp)  # warm repeat: prefix-cache resume traffic
-    assert forward_paged_packed._cache_size() == after_warmup
+    with compile_guard(forward_paged_packed._cache_size,
+                       label="mixed packed traffic"):
+        eng.generate(prompts, sp)
+        eng.generate(prompts, sp)  # warm repeat: prefix-cache resume traffic
     # the collapse claim: packed shapes never exceed the padded engine's
     # (row bucket x width bucket) grid for the same geometry
     padded = _make_engine(params, cfg, prefill_widths=2)

@@ -5,6 +5,7 @@ keeps its schema, and the production tree itself stays lint-clean."""
 from __future__ import annotations
 
 import json
+import shutil
 import subprocess
 import sys
 from pathlib import Path
@@ -25,25 +26,33 @@ from tools.tpulint.core import (  # noqa: E402
     iter_py_files,
     run_paths,
 )
-from tools.tpulint.reporters import render_json, render_rule_list, render_text  # noqa: E402
+from tools.tpulint.reporters import (  # noqa: E402
+    render_json,
+    render_rule_list,
+    render_sarif,
+    render_text,
+)
 from tools.tpulint.rules import RULES  # noqa: E402
 
 FIXTURES = REPO / "tests" / "lint_fixtures"
 WPA_FIXTURES = FIXTURES / "wpa"
+SHP_FIXTURES = FIXTURES / "shp"
 RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
             "TPU007", "ASY001", "ASY002", "OBS001"]
 WPA_RULE_IDS = ["WPA001", "WPA002", "WPA003", "WPA004"]
+SHP_RULE_IDS = ["SHP001", "SHP002", "SHP003", "SHP004"]
+ALL_RULE_IDS = RULE_IDS + WPA_RULE_IDS + SHP_RULE_IDS
 
 
 # ------------------------------------------------------------------ registry
 
 def test_registry_has_the_documented_rule_set():
-    assert sorted(RULES) == sorted(RULE_IDS + WPA_RULE_IDS)
+    assert sorted(RULES) == sorted(ALL_RULE_IDS)
 
 
 def test_list_rules_mentions_every_id():
     listing = render_rule_list()
-    for rule_id in RULE_IDS + WPA_RULE_IDS:
+    for rule_id in ALL_RULE_IDS:
         assert rule_id in listing
 
 
@@ -108,6 +117,93 @@ def test_wpa_suppressed_fixture_is_silenced_with_justification(rule_id):
     assert all(f.suppressed and f.justification for f in hits)
     # a used suppression must not be swept as stale
     assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
+# The SHP (shapeflow) fixtures follow the WPA convention: each rule has a
+# pos/neg/sup mini-package, and the SHP001 positive is deliberately
+# cross-module — the source is in serving.py, the sink in shapes.py, so
+# only the interprocedural taint pass can connect them.
+
+@pytest.mark.parametrize("rule_id", SHP_RULE_IDS)
+def test_shp_positive_fixture_fires(rule_id):
+    findings, _ = run_paths([SHP_FIXTURES / f"{rule_id.lower()}_pos"])
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, f"{rule_id} did not fire on its positive fixture package"
+    assert all(not f.suppressed for f in hits)
+    assert [f.rule for f in findings] == [rule_id] * len(hits)
+
+
+@pytest.mark.parametrize("rule_id", SHP_RULE_IDS)
+def test_shp_negative_fixture_is_silent(rule_id):
+    findings, _ = run_paths([SHP_FIXTURES / f"{rule_id.lower()}_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+@pytest.mark.parametrize("rule_id", SHP_RULE_IDS)
+def test_shp_suppressed_fixture_is_silenced_with_justification(rule_id):
+    findings, _ = run_paths([SHP_FIXTURES / f"{rule_id.lower()}_sup"])
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
+def test_shp001_message_carries_cross_module_taint_chain():
+    """Every SHP001 must ship its witness: the source step, each hop, and
+    the sink, with file:line anchors — here spanning two modules."""
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_pos"])
+    (hit,) = [f for f in findings if f.rule == "SHP001"]
+    assert hit.taint_chain and len(hit.taint_chain) >= 3
+    assert "Taint:" in hit.message
+    assert "len(requests)" in hit.taint_chain[0]
+    assert "serving.py" in hit.taint_chain[0]  # source module
+    assert "shapes.py" in hit.taint_chain[-1]  # sink module
+    for step in hit.taint_chain:
+        assert ":" in step and "[" in step  # every step carries file:line
+
+
+# ------------------------------------------------------- planted regressions
+# Mutation tests against the REAL tree: re-introduce the two classes of bug
+# the shapeflow pass exists to catch, and prove it catches them.
+
+def _mutated_tree(tmp_path, relpath: str, needle: str, replacement: str) -> Path:
+    src_root = REPO / "githubrepostorag_tpu"
+    dst = tmp_path / "githubrepostorag_tpu"
+    shutil.copytree(src_root, dst, ignore=shutil.ignore_patterns("__pycache__"))
+    target = dst / relpath
+    text = target.read_text()
+    assert needle in text, f"mutation needle vanished from {relpath}"
+    target.write_text(text.replace(needle, replacement, 1))
+    return dst
+
+
+def test_planted_engine_debucketing_is_caught_as_shp001(tmp_path):
+    """Strip the bucket barrier from the spec-burst row sizing: the
+    request-derived batch size then reaches the dispatch shapes raw, and
+    SHP001 must fire with a full witness chain."""
+    dst = _mutated_tree(
+        tmp_path, "serving/engine.py",
+        "rb = _bucket(len(running), self.max_num_seqs, minimum=1)",
+        "rb = len(running)")
+    findings, _ = run_paths([dst])
+    hits = [f for f in findings if f.rule == "SHP001" and not f.suppressed]
+    assert hits, "debucketed engine row sizing escaped the taint pass"
+    assert all(f.taint_chain for f in hits)
+    assert any("len(running)" in f.taint_chain[0] for f in hits)
+
+
+def test_planted_encoder_warmup_removal_is_caught_as_shp002(tmp_path):
+    """Rename the encoder's warmup: the class then runs its bucketed
+    embed dispatches with no warmup routine — the exact in-tree bug this
+    pass found — and SHP002 must flag the class."""
+    dst = _mutated_tree(
+        tmp_path, "embedding.py",
+        "def warmup(self) -> int:",
+        "def _prime_ladder(self) -> int:")
+    findings, _ = run_paths([dst])
+    hits = [f for f in findings if f.rule == "SHP002" and not f.suppressed]
+    assert any("JaxBertTextEncoder" in f.message for f in hits), (
+        "warmup removal on JaxBertTextEncoder escaped SHP002")
 
 
 def test_wpa004_positive_catches_both_leak_and_double_free():
@@ -223,7 +319,7 @@ def test_parse_error_becomes_a_finding_not_a_crash():
 def test_json_reporter_schema():
     findings, stats = run_paths([FIXTURES / "asy001_pos.py"])
     payload = json.loads(render_json(findings, stats))
-    assert payload["version"] == 2
+    assert payload["version"] == 3
     assert set(payload["stats"]) == {"files", "findings", "unsuppressed",
                                      "suppressed", "baselined"}
     assert payload["stats"]["files"] == 1
@@ -231,10 +327,51 @@ def test_json_reporter_schema():
     for entry in payload["findings"]:
         assert set(entry) == {"path", "line", "col", "rule", "message",
                               "suppressed", "justification", "qualname",
-                              "baselined"}
+                              "baselined", "taint_chain"}
         assert entry["rule"] in RULE_IDS
         assert entry["qualname"]  # every finding is attributed to a scope
-    assert set(payload["rules"]) == set(RULE_IDS + WPA_RULE_IDS)
+    assert set(payload["rules"]) == set(ALL_RULE_IDS)
+
+
+def test_json_reporter_carries_taint_chain_for_shp001():
+    findings, stats = run_paths([SHP_FIXTURES / "shp001_pos"])
+    payload = json.loads(render_json(findings, stats))
+    (entry,) = [e for e in payload["findings"] if e["rule"] == "SHP001"]
+    assert isinstance(entry["taint_chain"], list) and len(entry["taint_chain"]) >= 3
+
+
+def test_sarif_reporter_schema():
+    """The SARIF output must be structurally valid 2.1.0: versioned, one
+    run, every result tied to a registered rule with a physical location,
+    and suppressed findings carried as SARIF suppressions (not dropped)."""
+    findings, stats = run_paths([SHP_FIXTURES / "shp001_pos",
+                                 SHP_FIXTURES / "shp003_sup"])
+    payload = json.loads(render_sarif(findings, stats))
+    assert payload["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in payload["$schema"]
+    (run,) = payload["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tpulint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(rule_ids) and set(rule_ids) == set(ALL_RULE_IDS)
+    for rule in driver["rules"]:
+        assert rule["shortDescription"]["text"]
+        assert rule["fullDescription"]["text"]
+    assert run["results"], "expected results for the positive fixtures"
+    for result in run["results"]:
+        assert result["ruleId"] in ALL_RULE_IDS
+        assert driver["rules"][result["ruleIndex"]]["id"] == result["ruleId"]
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+    by_rule = {r["ruleId"]: r for r in run["results"]}
+    # SHP001's witness rides in the message text
+    assert "taint chain:" in by_rule["SHP001"]["message"]["text"]
+    assert "suppressions" not in by_rule["SHP001"]
+    sup = by_rule["SHP003"]["suppressions"][0]
+    assert sup["kind"] == "inSource" and sup["justification"]
+    assert run["properties"]["stats"]["suppressed"] == 1
 
 
 def test_text_reporter_lists_location_and_rule():
@@ -278,6 +415,76 @@ def test_cli_unknown_suppression_rule_gets_its_own_exit_code():
     # a misspelled rule id silences nothing; exit 3 makes CI fail loudly
     # instead of quietly un-suppressing
     assert _run_cli("tests/lint_fixtures/suppress_unknown.py").returncode == 3
+
+
+def test_cli_sarif_output_parses():
+    proc = _run_cli("tests/lint_fixtures/tpu006_pos.py", "--format", "sarif")
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == "2.1.0"
+    assert payload["runs"][0]["results"][0]["ruleId"] == "TPU006"
+
+
+# ----------------------------------------------------------------- diff mode
+
+def test_diff_closure_follows_reverse_dependencies():
+    """A changed util must pull in its (transitive) importers — they are
+    where a cross-module regression would surface — but not the modules it
+    merely imports."""
+    from tools.tpulint import diffmode
+
+    entries = [
+        ("pkg/__init__.py", ""),
+        ("pkg/util.py", "def bucket(n):\n    return n\n"),
+        ("pkg/engine.py", "from pkg.util import bucket\n"),
+        ("pkg/api.py", "from pkg.engine import run\n"),
+        ("pkg/other.py", "VALUE = 1\n"),
+    ]
+    real = diffmode.changed_files
+    diffmode.changed_files = lambda ref: {"pkg/util.py"}
+    try:
+        closure = diffmode.diff_closure(entries, "HEAD")
+    finally:
+        diffmode.changed_files = real
+    assert closure == {"pkg/util.py", "pkg/engine.py", "pkg/api.py"}
+
+
+def test_diff_mode_scopes_findings_to_the_closure(monkeypatch):
+    """Whole-program analysis still sees every file (no fabricated or lost
+    cross-module facts), but only closure files report findings: changing
+    the taint SOURCE module reports nothing (the sink file is out of
+    scope), while changing the SINK module reports the cross-module
+    SHP001."""
+    from tools.tpulint import diffmode
+
+    pkg = SHP_FIXTURES / "shp001_pos"
+    serving = str(pkg / "serving.py").replace("\\", "/")
+    shapes = str(pkg / "shapes.py").replace("\\", "/")
+
+    monkeypatch.setattr(diffmode, "changed_files", lambda ref: {serving})
+    findings, stats = run_paths([pkg], diff_base="HEAD")
+    assert stats["diff_selected"] == 1
+    assert findings == []  # the SHP001 anchors in shapes.py, out of scope
+
+    monkeypatch.setattr(diffmode, "changed_files", lambda ref: {shapes})
+    findings, stats = run_paths([pkg], diff_base="HEAD")
+    # shapes.py changed; serving.py imports it, so both are in scope
+    assert stats["diff_selected"] == 2
+    assert [f.rule for f in findings] == ["SHP001"]
+
+
+def test_cli_diff_with_bad_ref_is_a_usage_error():
+    proc = _run_cli("tests/lint_fixtures/tpu001_neg.py",
+                    "--diff", "no-such-ref-xyzzy")
+    assert proc.returncode == 2
+    assert "--diff" in proc.stderr
+
+
+def test_cli_diff_reports_scope_in_stats():
+    proc = _run_cli("tests/lint_fixtures/tpu001_neg.py", "--diff", "HEAD",
+                    "--format", "json")
+    assert proc.returncode in (0, 1)
+    payload = json.loads(proc.stdout)
+    assert isinstance(payload["stats"]["diff_selected"], int)
 
 
 # ------------------------------------------------------------------ baseline

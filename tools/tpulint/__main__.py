@@ -18,7 +18,12 @@ from tools.tpulint.core import (
     run_paths,
     write_baseline,
 )
-from tools.tpulint.reporters import render_json, render_rule_list, render_text
+from tools.tpulint.reporters import (
+    render_json,
+    render_rule_list,
+    render_sarif,
+    render_text,
+)
 
 EXIT_CLEAN = 0
 EXIT_FINDINGS = 1
@@ -34,7 +39,13 @@ def main(argv: list[str] | None = None) -> int:
         "`# tpulint: disable=RULE -- justification`.",
     )
     parser.add_argument("paths", nargs="*", help="files or directories to analyze")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default="text")
+    parser.add_argument(
+        "--diff", metavar="BASE_REF",
+        help="lint only files changed vs this git ref plus their "
+        "reverse-dependency closure (fast pre-push runs; the whole-program "
+        "graph still covers every file)",
+    )
     parser.add_argument(
         "--exclude", action="append", default=[],
         help="skip paths containing this substring (repeatable)",
@@ -65,7 +76,14 @@ def main(argv: list[str] | None = None) -> int:
             print("tpulint: error: no paths given", file=sys.stderr)
             return EXIT_USAGE
 
-        findings, stats = run_paths(args.paths, args.exclude)
+        try:
+            findings, stats = run_paths(args.paths, args.exclude,
+                                        diff_base=args.diff)
+        except Exception as exc:  # git missing / bad ref in --diff mode
+            if args.diff is None:
+                raise
+            print(f"tpulint: error: --diff {args.diff}: {exc}", file=sys.stderr)
+            return EXIT_USAGE
 
         if args.write_baseline:
             write_baseline(Path(args.write_baseline), findings)
@@ -83,6 +101,8 @@ def main(argv: list[str] | None = None) -> int:
 
         if args.format == "json":
             print(render_json(findings, stats))
+        elif args.format == "sarif":
+            print(render_sarif(findings, stats))
         else:
             print(render_text(findings, stats, show_suppressed=args.show_suppressed))
 

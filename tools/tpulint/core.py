@@ -37,6 +37,9 @@ from typing import Iterable, Iterator
 
 from tools.tpulint.rules import RULES, FileContext
 from tools.tpulint.program import analyze_program
+# importing shapeflow registers the SHP rule descriptors in RULES, so
+# suppression directives and --list-rules know them before any run
+import tools.tpulint.shapeflow  # noqa: F401
 
 # meta-rule ids (not suppressible findings about findings)
 RULE_NO_JUSTIFICATION = "LNT000"
@@ -64,6 +67,8 @@ class Finding:
     justification: str | None = None
     qualname: str | None = None
     baselined: bool = False
+    # shapeflow witness: source -> barrier-free path -> sink (SHP001)
+    taint_chain: tuple[str, ...] | None = None
 
     def location(self) -> str:
         return f"{self.path}:{self.line}:{self.col}"
@@ -154,8 +159,13 @@ class _FileAnalysis:
     is_test_file: bool
 
 
-def _collect_file(source: str, path: str) -> _FileAnalysis:
-    """Per-file rules + suppression directives, *without* applying them."""
+def _collect_file(source: str, path: str, run_rules: bool = True) -> _FileAnalysis:
+    """Per-file rules + suppression directives, *without* applying them.
+
+    ``run_rules=False`` (diff mode, file outside the change closure) still
+    parses the file and collects its suppressions — the tree feeds the
+    whole-program graph and the suppressions must keep silencing program
+    findings — but skips the per-file rule work and meta findings."""
     base = path.replace("\\", "/").rsplit("/", 1)[-1]
     is_test = base.startswith(("test_", "conftest"))
     try:
@@ -164,12 +174,15 @@ def _collect_file(source: str, path: str) -> _FileAnalysis:
         finding = Finding(path, exc.lineno or 1, exc.offset or 0, RULE_PARSE_ERROR,
                           f"file does not parse: {exc.msg}")
         return _FileAnalysis(path, source, None, [finding], [], [], is_test)
-    ctx = FileContext(path=path, source=source, tree=tree)
     findings: list[Finding] = []
-    for rule in RULES.values():
-        for line, col, message in rule.check(ctx):
-            findings.append(Finding(path, line, col, rule.id, message))
+    if run_rules:
+        ctx = FileContext(path=path, source=source, tree=tree)
+        for rule in RULES.values():
+            for line, col, message in rule.check(ctx):
+                findings.append(Finding(path, line, col, rule.id, message))
     suppressions, meta = _parse_suppressions(source, path)
+    if not run_rules:
+        meta = []
     return _FileAnalysis(path, source, tree, findings, suppressions, meta, is_test)
 
 
@@ -263,17 +276,34 @@ def iter_py_files(paths: Iterable[str | Path], excludes: Iterable[str] = ()) -> 
 
 
 def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = (),
-              *, program: bool = True) -> tuple[list[Finding], dict]:
+              *, program: bool = True,
+              diff_base: str | None = None) -> tuple[list[Finding], dict]:
     """Analyze every .py under ``paths`` -> (findings, stats).
 
     Runs the per-file rules AND the whole-program pass, merges both finding
     streams per file, applies suppressions over the merged stream, then
     sweeps for stale (zero-match) suppressions.
+
+    ``diff_base``: lint only files changed vs that git ref plus their
+    reverse-dependency closure (files that import them, transitively).
+    Every file still parses and feeds the whole-program graph — partial
+    graphs would fabricate WPA/SHP findings — but per-file rule work and
+    reported findings are restricted to the closure.
     """
+    entries = [(str(p), p.read_text(encoding="utf-8", errors="replace"))
+               for p in iter_py_files(paths, excludes)]
+
+    only: set[str] | None = None
+    if diff_base is not None:
+        from tools.tpulint.diffmode import diff_closure
+        only = diff_closure(entries, diff_base)
+
+    def in_scope(path: str) -> bool:
+        return only is None or path.replace("\\", "/") in only
+
     analyses: list[_FileAnalysis] = []
-    for p in iter_py_files(paths, excludes):
-        source = p.read_text(encoding="utf-8", errors="replace")
-        analyses.append(_collect_file(source, str(p)))
+    for path, source in entries:
+        analyses.append(_collect_file(source, path, run_rules=in_scope(path)))
 
     if program:
         prog_files = [(fa.path, fa.tree, fa.source) for fa in analyses
@@ -282,13 +312,18 @@ def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = (),
         for pf in analyze_program(prog_files):
             prog_by_path.setdefault(pf.path, []).append(pf)
         for fa in analyses:
+            if not in_scope(fa.path):
+                continue
             for pf in prog_by_path.get(fa.path.replace("\\", "/"), ()):
                 fa.findings.append(Finding(fa.path, pf.line, pf.col,
-                                           pf.rule, pf.message))
+                                           pf.rule, pf.message,
+                                           taint_chain=pf.chain))
 
     findings: list[Finding] = []
     for fa in analyses:
         _apply_suppressions(fa.findings, fa.suppressions)
+        if not in_scope(fa.path):
+            continue
         for sup in fa.suppressions:
             if (sup.justification and not sup.used
                     and not sup.has_unknown_rule):
@@ -311,6 +346,8 @@ def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = (),
         "suppressed": len(findings) - unsuppressed,
         "baselined": 0,
     }
+    if only is not None:
+        stats["diff_selected"] = len(only)
     return findings, stats
 
 

@@ -1,0 +1,88 @@
+"""Diff mode: restrict a lint run to changed files + reverse dependencies.
+
+``tpulint --diff BASE_REF`` lints only the files that differ from a git
+ref, **plus** every analyzed file that (transitively) imports one of them
+— a change to ``utils.next_bucket`` must re-lint the engine that calls it,
+or the fast pre-push run would miss exactly the cross-module regressions
+the whole-program rules exist for.  The closure is computed over the
+in-repo import graph (the same module-name resolution the program graph
+uses); files outside the closure still parse and feed the program graph,
+they just don't run rules or report findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import subprocess
+
+from tools.tpulint.program import _collect_aliases, module_name_for
+
+
+def changed_files(base_ref: str) -> set[str]:
+    """Paths (repo-relative, posix) of .py files changed vs ``base_ref``,
+    including uncommitted working-tree changes and untracked files."""
+    out: set[str] = set()
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", base_ref, "--", "*.py"],
+        capture_output=True, text=True, check=True)
+    out.update(line.strip() for line in diff.stdout.splitlines() if line.strip())
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+        capture_output=True, text=True, check=True)
+    out.update(line.strip() for line in untracked.stdout.splitlines() if line.strip())
+    return out
+
+
+def _import_graph(entries: list[tuple[str, str]]) -> tuple[dict[str, str], dict[str, set[str]]]:
+    """(module name per path, reverse import edges: path -> importer paths).
+
+    Only imports that resolve to another analyzed file become edges —
+    stdlib/third-party imports are irrelevant to the closure.
+    """
+    norm = [(p.replace("\\", "/"), src) for p, src in entries]
+    have_init: dict[tuple[str, ...], bool] = {}
+    for p, _ in norm:
+        parts = tuple(p[:-3].split("/"))
+        if parts[-1] == "__init__":
+            have_init[parts[:-1]] = True
+    mod_by_path: dict[str, str] = {}
+    path_by_mod: dict[str, str] = {}
+    trees: dict[str, ast.Module] = {}
+    for p, src in norm:
+        try:
+            trees[p] = ast.parse(src, filename=p)
+        except SyntaxError:
+            continue
+        modname = module_name_for(tuple(p[:-3].split("/")), have_init)
+        mod_by_path[p] = modname
+        path_by_mod[modname] = p
+    importers: dict[str, set[str]] = {}
+    for p, tree in trees.items():
+        modname = mod_by_path.get(p, p)
+        for target in _collect_aliases(tree, modname).values():
+            # longest analyzed-module prefix of the target is the dependency
+            parts = target.split(".")
+            for cut in range(len(parts), 0, -1):
+                dep = path_by_mod.get(".".join(parts[:cut]))
+                if dep is not None:
+                    if dep != p:
+                        importers.setdefault(dep, set()).add(p)
+                    break
+    return mod_by_path, importers
+
+
+def diff_closure(entries: list[tuple[str, str]], base_ref: str) -> set[str]:
+    """Analyzed paths in the lint scope for ``--diff base_ref``."""
+    changed = changed_files(base_ref)
+    analyzed = {p.replace("\\", "/") for p, _ in entries}
+    seeds = analyzed & changed
+    _, importers = _import_graph(entries)
+    closure = set(seeds)
+    stack = list(seeds)
+    while stack:
+        p = stack.pop()
+        for importer in importers.get(p, ()):
+            if importer not in closure:
+                closure.add(importer)
+                stack.append(importer)
+    return closure

@@ -141,6 +141,8 @@ class ProgramFinding:
     col: int
     rule: str
     message: str
+    # shapeflow taint witness: source -> ... -> sink step strings (SHP001)
+    chain: tuple[str, ...] | None = None
 
 
 # --------------------------------------------------------------------------
@@ -1235,5 +1237,9 @@ def analyze_program(files: list[tuple[str, ast.Module, str]]) -> list[ProgramFin
     findings: list[ProgramFinding] = []
     for rule_id in sorted(_WPA_CHECKS):
         findings.extend(_WPA_CHECKS[rule_id](program))
+    # the shape-provenance pass shares this Program instance; the import is
+    # deferred because shapeflow imports this module's data model
+    from tools.tpulint.shapeflow import run_shapeflow
+    findings.extend(run_shapeflow(program))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

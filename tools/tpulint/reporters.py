@@ -1,19 +1,25 @@
-"""Finding reporters: human text and machine JSON.
+"""Finding reporters: human text, machine JSON, and SARIF.
 
 The JSON shape is stable API for CI consumers:
 
     {
-      "version": 2,
+      "version": 3,
       "findings": [{"path", "line", "col", "rule", "message",
                     "suppressed", "justification", "qualname",
-                    "baselined"}, ...],
+                    "baselined", "taint_chain"}, ...],
       "stats": {"files", "findings", "unsuppressed", "suppressed",
                 "baselined"},
       "rules": {"TPU001": "<summary>", ...}
     }
 
 Version history: v1 had no qualname/baselined fields and no baselined
-stat; consumers pinning v1 must update when reading v2 output.
+stat; v2 added them; v3 adds ``taint_chain`` (the shapeflow SHP001
+source→sink witness — a list of step strings, or null for every other
+rule).  Consumers pinning an older version must update when reading v3.
+
+``render_sarif`` emits SARIF 2.1.0 so findings render as GitHub
+code-scanning annotations; suppressed/baselined findings carry a SARIF
+``suppressions`` entry so the UI hides them without losing the record.
 """
 
 from __future__ import annotations
@@ -24,7 +30,10 @@ from typing import Iterable
 from tools.tpulint.core import Finding
 from tools.tpulint.rules import RULES
 
-JSON_SCHEMA_VERSION = 2
+JSON_SCHEMA_VERSION = 3
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
 
 
 def render_text(findings: Iterable[Finding], stats: dict, show_suppressed: bool = False) -> str:
@@ -44,6 +53,8 @@ def render_text(findings: Iterable[Finding], stats: dict, show_suppressed: bool 
     )
     if stats.get("baselined"):
         summary += f", {stats['baselined']} baselined"
+    if stats.get("diff_selected") is not None:
+        summary += f", diff scope {stats['diff_selected']} file(s)"
     lines.append(summary)
     return "\n".join(lines)
 
@@ -62,11 +73,78 @@ def render_json(findings: Iterable[Finding], stats: dict) -> str:
                 "justification": f.justification,
                 "qualname": f.qualname,
                 "baselined": f.baselined,
+                "taint_chain": list(f.taint_chain) if f.taint_chain else None,
             }
             for f in findings
         ],
         "stats": dict(stats),
         "rules": {rule_id: rule.summary for rule_id, rule in RULES.items()},
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)
+
+
+def render_sarif(findings: Iterable[Finding], stats: dict) -> str:
+    """SARIF 2.1.0 for GitHub code-scanning upload."""
+    rules = [
+        {
+            "id": rule_id,
+            "shortDescription": {"text": rule.summary},
+            "fullDescription": {"text": rule.details},
+            "defaultConfiguration": {"level": "warning"},
+        }
+        for rule_id, rule in sorted(RULES.items())
+    ]
+    rule_index = {r["id"]: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        message = f.message
+        if f.taint_chain:
+            message += "\ntaint chain:\n" + "\n".join(
+                f"  {i + 1}. {step}" for i, step in enumerate(f.taint_chain))
+        result: dict = {
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace("\\", "/"),
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": max(f.col + 1, 1),
+                        },
+                    }
+                }
+            ],
+        }
+        if f.rule in rule_index:
+            result["ruleIndex"] = rule_index[f.rule]
+        if f.suppressed:
+            result["suppressions"] = [
+                {"kind": "inSource", "justification": f.justification or ""}]
+        elif f.baselined:
+            result["suppressions"] = [{"kind": "external"}]
+        results.append(result)
+    payload = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "informationUri": "https://example.invalid/tpulint",
+                        "rules": rules,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+                "properties": {"stats": dict(stats)},
+            }
+        ],
     }
     return json.dumps(payload, indent=2, sort_keys=False)
 

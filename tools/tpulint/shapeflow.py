@@ -1,0 +1,1052 @@
+"""Shape-provenance dataflow: prove the zero-live-recompile contract.
+
+Every perf feature in this repo (packed prefill, device index, spec
+decoding, KV tiering) leans on one invariant: a request-derived size must
+pass through a bucketing ladder before it reaches a ``jit``/``pallas_call``
+boundary, and warmup must precompile exactly that ladder.  Until now the
+invariant was only checked dynamically — per-feature ``_cache_size()``
+deltas in tests and the runtime CompileWatchdog.  This pass proves it
+statically, for every current and future jit site, on top of the
+``program.py`` cross-module call graph.
+
+Taint model
+-----------
+
+*Sources* (request-derived values):
+
+* ``len(x)`` where ``x`` names request-sized data (``req.prompt``,
+  ``tokens``, ``queue``, ``running``, ``texts``, ...), or where ``x`` is
+  itself tainted;
+* attribute loads on request-like receivers (``req.seq_len``,
+  ``job.prompt`` — a receiver named ``req``/``request``/``job``/...);
+* ``.qsize()`` of any queue;
+* ``k``/``top_k`` parameters of public, non-jitted functions (the
+  retrieval fan-out knob arrives straight from the request);
+* ``.shape`` of an array whose shape is already request-derived.
+
+Two taint *kinds* flow:
+
+* ``size`` — a Python int derived from request data;
+* ``array`` — a **host** array allocated with a tainted dimension
+  (``np.zeros((len(texts), d))``).  A host-only staging buffer is fine;
+  the hazard fires only when such an array reaches a jitted callee (its
+  shape then keys a fresh XLA compile).
+
+*Propagation*: arithmetic, ``min``/``max``, tuple/list/dict packing,
+subscripts, ``asarray``-style conversions, and ordinary call edges (a
+tainted argument taints the callee's parameter; tainted returns taint the
+call site) — to an interprocedural fixpoint over the whole program graph.
+
+*Barriers* launder taint: a call whose resolved target (aliases included,
+so ``next_bucket as _bucket`` counts) matches ``bucket``/``ladder``, or
+any call on a line carrying a ``# tpulint: bucket`` annotation.  Bucketed
+values are exactly the warmup-precompiled ladder, so they are clean.
+
+Rules
+-----
+
+* **SHP001** — a tainted value reaches a shape position (``jnp.zeros`` /
+  ``full`` / ``pad`` / ``reshape`` / ``broadcast_to`` / ``tile`` /
+  ``ShapeDtypeStruct``, a ``static_argnums``/``static_argnames`` argument
+  of a jitted callee, a Pallas ``grid``/``BlockSpec``) — or a
+  request-shaped host array is traced by a jitted callee — without
+  passing a barrier.  The message carries the full source → sink witness
+  chain.
+* **SHP002** — warmup-coverage: a jit dispatch site reachable from a
+  class's live (hot-path) methods must also be reachable from *some*
+  warmup routine; and a class that runs bucketed jit dispatches on its
+  live path must define a warmup routine at all.  A ladder used in
+  traffic but absent from warmup is a latent live compile.
+* **SHP003** — ``jax.jit`` / ``functools.partial(jit, ...)`` /
+  ``pallas_call`` constructed inside a per-request/per-step scope: the
+  compile cache is rebuilt every call.  Factories (``make_*``/``build_*``
+  /``init_*``/``__init__``) and ``self.<attr> = jax.jit(...)``
+  memoizations are exempt.
+* **SHP004** — weak-type instability: a Python scalar literal mixed into
+  a traced argument's arithmetic where the other operand's dtype is
+  config-tainted (``kv_quant``-style scale/dtype values) — the literal's
+  weak type resolves differently per config and keys dtype recompiles.
+
+Everything is stdlib-``ast``, runs on the already-built ``Program``, and
+is wired into ``analyze_program`` so one grammar (suppressions, baseline,
+reporters) covers WPA and SHP findings alike.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import deque
+from dataclasses import dataclass
+
+from tools.tpulint.program import (
+    Edge,
+    FuncInfo,
+    ModuleInfo,
+    Program,
+    ProgramFinding,
+    _register_program_rule,
+    _walk_own,
+)
+from tools.tpulint.rules import JitSpec, dotted, jit_spec_of, jitted_callables, jitted_functions
+
+# --------------------------------------------------------------------------
+# taint values
+
+KIND_SIZE = "size"      # request-derived Python int
+KIND_ARRAY = "array"    # host array with a request-derived dimension
+
+_MAX_CHAIN = 8
+
+
+@dataclass(frozen=True)
+class Taint:
+    kind: str
+    chain: tuple[str, ...]
+
+    def extend(self, step: str) -> "Taint":
+        if len(self.chain) >= _MAX_CHAIN:
+            return self
+        return Taint(self.kind, self.chain + (step,))
+
+    def as_kind(self, kind: str, step: str) -> "Taint":
+        if len(self.chain) >= _MAX_CHAIN:
+            return Taint(kind, self.chain)
+        return Taint(kind, self.chain + (step,))
+
+
+def _join(*taints: "Taint | None") -> "Taint | None":
+    """First-wins join; ``array`` outranks ``size`` (it carries the
+    stronger hazard — a whole buffer keyed on the request)."""
+    best: Taint | None = None
+    for t in taints:
+        if t is None:
+            continue
+        if best is None or (best.kind == KIND_SIZE and t.kind == KIND_ARRAY):
+            best = t
+    return best
+
+
+# --------------------------------------------------------------------------
+# source / barrier / sink vocabulary
+
+# snake-case tokens that mark a name as request-sized data
+_REQUEST_TOKENS = {
+    "req", "reqs", "request", "requests", "job", "jobs", "prompt", "prompts",
+    "token", "tokens", "queue", "pending", "running", "waiting", "active",
+    "texts", "queries", "query", "docs", "documents", "msgs", "messages",
+    "chunks", "outputs", "candidates", "drafts", "hits", "results",
+}
+
+_BARRIER_NAME_RE = re.compile(r"bucket|ladder", re.IGNORECASE)
+_BUCKET_ANNOTATION = re.compile(r"#\s*tpulint:\s*bucket\b")
+
+# method / function names that put a class on the live serving path
+_HOT_NAME_RE = re.compile(
+    r"step|decode|prefill|burst|search|dispatch|migrate|sample|forward"
+    r"|encode|retrieve|generate|stream|submit|enqueue|drain|commit|serve",
+    re.IGNORECASE,
+)
+_WARMUP_NAME_RE = re.compile(r"warmup|warm_up|prewarm|precompile", re.IGNORECASE)
+_FACTORY_NAME_RE = re.compile(r"^_?(make|build|create|init|get|load|setup)_|^__init__$")
+
+_DEVICE_ROOTS = {"jnp", "jax", "lax"}
+_HOST_ROOTS = {"np", "numpy"}
+_CREATION_NAMES = {
+    "zeros", "ones", "empty", "full", "arange", "eye", "linspace", "tri",
+    "iota", "broadcasted_iota",
+}
+_RESHAPEISH = {"reshape", "broadcast_to", "tile", "pad", "resize"}
+_PASSTHROUGH_BUILTINS = {"int", "abs", "round", "sorted", "list", "tuple", "sum", "float"}
+_ASARRAYISH = {"asarray", "array", "ascontiguousarray", "stack", "concatenate", "device_put"}
+_CONFIG_DTYPE_RE = re.compile(r"quant|scale|dtype", re.IGNORECASE)
+
+
+def _name_tokens(d: str) -> set[str]:
+    return {tok for part in d.split(".") for tok in part.split("_") if tok}
+
+
+def _request_named(expr: ast.AST) -> str | None:
+    """Source text of ``expr`` when its name marks it request-sized."""
+    d = dotted(expr)
+    if d is None:
+        if isinstance(expr, ast.Subscript):
+            return _request_named(expr.value)
+        if isinstance(expr, ast.Call):  # len(x.values()), len(q.get())
+            return _request_named(expr.func)
+        return None
+    tokens = _name_tokens(d)
+    tokens.discard("self")
+    if tokens & _REQUEST_TOKENS:
+        return d
+    return None
+
+
+_RECEIVER_RE = re.compile(r"^(req|request|job|msg)$")
+
+
+# --------------------------------------------------------------------------
+# the pass
+
+class ShapeFlow:
+    """Interprocedural taint over one built ``Program``."""
+
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        self.jit_spec_by_fn: dict[int, JitSpec] = {}
+        self._jit_by_qual: dict[str, JitSpec] = {}
+        self.param_taint: dict[int, dict[str, Taint]] = {}
+        self.ret_taint: dict[int, Taint] = {}
+        self._dirty: list[FuncInfo] = []
+        self.findings: list[ProgramFinding] = []
+        self._seen_keys: set[tuple] = set()
+        # callable *references* (partial(f, ...), shard_map(f), callbacks)
+        # the call graph has no edge for — reachability must follow them
+        self.ref_edges: dict[int, list[FuncInfo]] = {}
+        self._index_jits()
+        self._collect_ref_edges()
+
+    # ----------------------------------------------------------- jit index
+
+    def _index_jits(self) -> None:
+        node_specs: dict[int, JitSpec] = {}
+        for mod in self.program.modules.values():
+            for node, spec in jitted_functions(mod.tree).items():
+                node_specs[id(node)] = spec
+            for name, spec in jitted_callables(mod.tree).items():
+                self._jit_by_qual[f"{mod.modname}.{name}"] = spec
+            # `g = jax.jit(f)`: the wrapped f's body runs under trace too
+            for node in ast.walk(mod.tree):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and jit_spec_of(node.value) is None):
+                    continue
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                    for arg in node.value.args[:1]:
+                        d = dotted(arg)
+                        if d and d in mod.functions:
+                            node_specs.setdefault(
+                                id(mod.functions[d].node), JitSpec())
+        for fi in self.program.functions:
+            spec = node_specs.get(id(fi.node))
+            if spec is not None:
+                self.jit_spec_by_fn[id(fi)] = spec
+
+    def _collect_ref_edges(self) -> None:
+        for fn in list(self.program.functions):
+            refs: list[FuncInfo] = []
+            for node in _walk_own(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(a, ast.Call):
+                        fd = (dotted(a.func) or "").rsplit(".", 1)[-1]
+                        if fd != "partial" or not a.args:
+                            continue
+                        a = a.args[0]
+                    if not isinstance(a, (ast.Name, ast.Attribute)):
+                        continue
+                    refs.extend(self.program.resolve_callable_ref(a, fn))
+            if refs:
+                self.ref_edges[id(fn)] = refs
+
+    def is_jitted(self, fi: FuncInfo) -> bool:
+        return id(fi) in self.jit_spec_by_fn
+
+    def jit_spec_for_call(
+        self, call: ast.Call, fn: FuncInfo
+    ) -> tuple[JitSpec | None, FuncInfo | None, str]:
+        """(spec, callee FuncInfo if known, display name) when ``call``
+        dispatches a jitted callable.  ``spec`` may be an empty JitSpec for
+        opaque ``self._foo_jit(...)``-style handles (staticness unknown)."""
+        if jit_spec_of(call) is not None:
+            return None, None, ""  # this call *constructs* a jit, no dispatch
+        callees = self._resolve(call, fn)
+        for fi in callees:
+            spec = self.jit_spec_by_fn.get(id(fi))
+            if spec is not None:
+                return spec, fi, fi.qualname
+        d = dotted(call.func)
+        if d:
+            head, _, rest = d.partition(".")
+            if head in fn.module.alias:
+                qual = fn.module.alias[head] + ("." + rest if rest else "")
+                spec = self._jit_by_qual.get(qual)
+                if spec is not None:
+                    return spec, None, qual
+            spec = self._jit_by_qual.get(f"{fn.module.modname}.{d}")
+            if spec is not None:
+                return spec, None, d
+            last = d.rsplit(".", 1)[-1]
+            if "jit" in last.lower() and last not in ("jit", "pjit"):
+                return JitSpec(), None, d  # opaque jitted handle
+        return None, None, ""
+
+    def _resolve(self, call: ast.Call, fn: FuncInfo) -> list[FuncInfo]:
+        d = dotted(call.func)
+        if isinstance(call.func, ast.Name):
+            return self.program.resolve_callable_ref(call.func, fn)
+        if d is not None:
+            return self.program._resolve_dotted_call(d, fn)
+        return []
+
+    # ----------------------------------------------------------- barriers
+
+    def is_barrier(self, call: ast.Call, fn: FuncInfo) -> bool:
+        lines = fn.module.source_lines
+        ln = call.lineno
+        if 1 <= ln <= len(lines) and _BUCKET_ANNOTATION.search(lines[ln - 1]):
+            return True
+        for fi in self._resolve(call, fn):
+            if _BARRIER_NAME_RE.search(fi.name):
+                return True
+        d = dotted(call.func)
+        if d and _BARRIER_NAME_RE.search(d.rsplit(".", 1)[-1]):
+            return True
+        return False
+
+    # ------------------------------------------------------ interprocedural
+
+    def record_call_taint(self, callee: FuncInfo, param: str, taint: Taint) -> None:
+        if self.is_jitted(callee):
+            return  # traced args don't key shapes; statics are sunk at the boundary
+        slot = self.param_taint.setdefault(id(callee), {})
+        if param not in slot:
+            slot[param] = taint
+            self._dirty.append(callee)
+
+    def run(self) -> list[ProgramFinding]:
+        order = sorted(self.program.functions, key=lambda f: f.qualname)
+        self._seed_params(order)
+        pending = deque(order)
+        queued = {id(f) for f in order}
+        while pending:
+            fn = pending.popleft()
+            queued.discard(id(fn))
+            interp = _Interp(self, fn, emit=False)
+            interp.run()
+            if interp.ret is not None and id(fn) not in self.ret_taint:
+                self.ret_taint[id(fn)] = interp.ret
+                for edge in self.program._callers_of.get(id(fn), ()):
+                    if id(edge.caller) not in queued:
+                        pending.append(edge.caller)
+                        queued.add(id(edge.caller))
+            for callee in self._dirty:
+                if id(callee) not in queued:
+                    pending.append(callee)
+                    queued.add(id(callee))
+            self._dirty.clear()
+        for fn in order:
+            _Interp(self, fn, emit=True).run()
+        self.findings.extend(_check_shp002(self))
+        self.findings.extend(_check_shp003(self))
+        self.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return self.findings
+
+    def _seed_params(self, order: list[FuncInfo]) -> None:
+        for fi in order:
+            if self.is_jitted(fi) or isinstance(fi.node, ast.Lambda):
+                continue
+            if fi.name.startswith("_"):
+                continue
+            if self.program._callers_of.get(id(fi)):
+                # an in-program caller decides what flows in (config k's
+                # stay clean); the seed models true external entry points
+                continue
+            for p in _params_of(fi):
+                if p in ("k", "top_k", "topk"):
+                    step = (f"request parameter '{p}' of {fi.qualname}() "
+                            f"[{fi.module.path}:{fi.node.lineno}]")
+                    self.param_taint.setdefault(id(fi), {}).setdefault(
+                        p, Taint(KIND_SIZE, (step,)))
+
+    # ------------------------------------------------------------ findings
+
+    def emit(self, fn: FuncInfo, node: ast.AST, rule: str, message: str,
+             chain: tuple[str, ...] = ()) -> None:
+        key = (fn.module.path, node.lineno, node.col_offset, rule)
+        if key in self._seen_keys:
+            return
+        self._seen_keys.add(key)
+        self.findings.append(ProgramFinding(
+            fn.module.path, node.lineno, node.col_offset, rule, message,
+            chain=chain or None))
+
+
+def _params_of(fi: FuncInfo) -> list[str]:
+    if isinstance(fi.node, ast.Lambda):
+        a = fi.node.args
+        return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    a = fi.node.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+# --------------------------------------------------------------------------
+# per-function abstract interpreter
+
+class _Interp:
+    """Statement-ordered taint interpreter for one function body.
+
+    Branch-sensitive: ``if``/``try``/``match`` arms run on copies and the
+    taints merge at the join (tainted-in-either wins); loop bodies run
+    twice so a taint set late in the body reaches uses early in it."""
+
+    def __init__(self, sf: ShapeFlow, fn: FuncInfo, emit: bool) -> None:
+        self.sf = sf
+        self.fn = fn
+        self.emit = emit
+        self.path = fn.module.path
+        self.env: dict[str, Taint] = dict(sf.param_taint.get(id(fn), {}))
+        self.ret: Taint | None = None
+        self._decorators = set()
+        deco = getattr(fn.node, "decorator_list", None) or []
+        for d in deco:
+            for sub in ast.walk(d):
+                self._decorators.add(id(sub))
+
+    # ------------------------------------------------------------- helpers
+
+    def _step(self, node: ast.AST, desc: str) -> str:
+        return f"{desc} [{self.path}:{node.lineno}]"
+
+    def _src(self, node: ast.AST) -> str:
+        d = dotted(node)
+        if d is not None:
+            return d
+        try:
+            return ast.unparse(node)[:40]
+        except Exception:
+            return "<expr>"
+
+    # ------------------------------------------------------------ statements
+
+    def run(self) -> None:
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            self.ret = self.eval(node.body, self.env)
+            return
+        self.exec_block(node.body, self.env)
+
+    def exec_block(self, stmts: list[ast.stmt], env: dict[str, Taint]) -> None:
+        for stmt in stmts:
+            self.exec_stmt(stmt, env)
+
+    @staticmethod
+    def _merge(into: dict[str, Taint], *branches: dict[str, Taint]) -> None:
+        for br in branches:
+            for name, t in br.items():
+                prev = into.get(name)
+                joined = _join(prev, t)
+                if joined is not None:
+                    into[name] = joined
+
+    def exec_stmt(self, stmt: ast.stmt, env: dict[str, Taint]) -> None:
+        if isinstance(stmt, ast.Assign):
+            t = self.eval(stmt.value, env)
+            for tgt in stmt.targets:
+                self._assign(tgt, stmt.value, t, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                t = self.eval(stmt.value, env)
+                self._assign(stmt.target, stmt.value, t, env)
+        elif isinstance(stmt, ast.AugAssign):
+            t = _join(self.eval(stmt.target, env, load_only=True),
+                      self.eval(stmt.value, env))
+            self._assign(stmt.target, stmt.value, t, env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.eval(stmt.iter, env)
+            body_env = dict(env)
+            for _ in range(2):
+                self.exec_block(stmt.body, body_env)
+            self._merge(env, body_env)
+            self.exec_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self.eval(stmt.test, env)
+            body_env = dict(env)
+            for _ in range(2):
+                self.exec_block(stmt.body, body_env)
+            self._merge(env, body_env)
+            self.exec_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test, env)
+            then_env, else_env = dict(env), dict(env)
+            self.exec_block(stmt.body, then_env)
+            self.exec_block(stmt.orelse, else_env)
+            # a var assigned clean in BOTH arms is clean after the join
+            for name in set(env) | set(then_env) | set(else_env):
+                a, b = then_env.get(name), else_env.get(name)
+                joined = _join(a, b)
+                if joined is None:
+                    env.pop(name, None)
+                else:
+                    env[name] = joined
+        elif isinstance(stmt, ast.Try):
+            self.exec_block(stmt.body, env)
+            for handler in stmt.handlers:
+                h_env = dict(env)
+                self.exec_block(handler.body, h_env)
+                self._merge(env, h_env)
+            self.exec_block(stmt.orelse, env)
+            self.exec_block(stmt.finalbody, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval(item.context_expr, env)
+            self.exec_block(stmt.body, env)
+        elif isinstance(stmt, ast.Match):
+            self.eval(stmt.subject, env)
+            arms = []
+            for case in stmt.cases:
+                c_env = dict(env)
+                self.exec_block(case.body, c_env)
+                arms.append(c_env)
+            self._merge(env, *arms)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.ret = _join(self.ret, self.eval(stmt.value, env))
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env)
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.eval(child, env)
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    env.pop(tgt.id, None)
+        # nested defs/classes are their own FuncInfos; imports carry no taint
+
+    def _assign(self, tgt: ast.AST, value: ast.AST, t: Taint | None,
+                env: dict[str, Taint]) -> None:
+        if isinstance(tgt, ast.Name):
+            if t is None:
+                env.pop(tgt.id, None)
+            else:
+                env[tgt.id] = t.extend(self._step(
+                    tgt, f"assigned to '{tgt.id}'")) if len(t.chain) < _MAX_CHAIN else t
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and len(value.elts) == len(tgt.elts):
+                for sub_t, sub_v in zip(tgt.elts, value.elts):
+                    self._assign(sub_t, sub_v, self.eval(sub_v, env), env)
+            else:
+                for sub in tgt.elts:
+                    inner = sub.value if isinstance(sub, ast.Starred) else sub
+                    self._assign(inner, value, t, env)
+        elif isinstance(tgt, ast.Starred):
+            self._assign(tgt.value, value, t, env)
+        # self.X / subscript stores: no attribute taint in v1 (precision)
+
+    # ---------------------------------------------------------- expressions
+
+    def eval(self, expr: ast.AST, env: dict[str, Taint],
+             load_only: bool = False) -> Taint | None:
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id)
+        if isinstance(expr, ast.Constant):
+            return None
+        if isinstance(expr, ast.Call):
+            return self.eval_call(expr, env)
+        if isinstance(expr, ast.BinOp):
+            return _join(self.eval(expr.left, env), self.eval(expr.right, env))
+        if isinstance(expr, ast.UnaryOp):
+            return self.eval(expr.operand, env)
+        if isinstance(expr, ast.BoolOp):
+            return _join(*[self.eval(v, env) for v in expr.values])
+        if isinstance(expr, ast.IfExp):
+            self.eval(expr.test, env)
+            return _join(self.eval(expr.body, env), self.eval(expr.orelse, env))
+        if isinstance(expr, ast.Compare):
+            self.eval(expr.left, env)
+            for c in expr.comparators:
+                self.eval(c, env)
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return _join(*[self.eval(e, env) for e in expr.elts])
+        if isinstance(expr, ast.Dict):
+            taints = [self.eval(v, env) for v in expr.values if v is not None]
+            for k in expr.keys:
+                if k is not None:
+                    self.eval(k, env)
+            return _join(*taints)
+        if isinstance(expr, ast.Starred):
+            return self.eval(expr.value, env)
+        if isinstance(expr, ast.Subscript):
+            self.eval(expr.slice, env)
+            return self.eval(expr.value, env)
+        if isinstance(expr, ast.Slice):
+            for part in (expr.lower, expr.upper, expr.step):
+                if part is not None:
+                    self.eval(part, env)
+            return None
+        if isinstance(expr, ast.Attribute):
+            return self._eval_attribute(expr, env)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            # comprehensions: evaluate sources; the element expr sees no
+            # bindings (over-approximation: result carries the iterables'
+            # taint so `[pad(t) for t in tokens]` stays request-sized)
+            taints = [self.eval(gen.iter, env) for gen in expr.generators]
+            return _join(*taints)
+        if isinstance(expr, ast.JoinedStr):
+            return None
+        if isinstance(expr, (ast.Await, ast.YieldFrom)):
+            return self.eval(expr.value, env) if expr.value is not None else None
+        if isinstance(expr, ast.Yield):
+            if expr.value is not None:
+                self.ret = _join(self.ret, self.eval(expr.value, env))
+            return None
+        if isinstance(expr, ast.NamedExpr):
+            t = self.eval(expr.value, env)
+            self._assign(expr.target, expr.value, t, env)
+            return t
+        if isinstance(expr, ast.Lambda):
+            return None
+        return None
+
+    def _eval_attribute(self, expr: ast.Attribute, env: dict[str, Taint]) -> Taint | None:
+        base = self.eval(expr.value, env)
+        if expr.attr == "shape":
+            if base is not None and base.kind == KIND_ARRAY:
+                return base.as_kind(KIND_SIZE, self._step(
+                    expr, "its .shape is request-derived"))
+            return None
+        if base is not None:
+            # attributes of tainted values (e.g. tainted dict entry) flow
+            return base
+        if isinstance(expr.value, ast.Name) and _RECEIVER_RE.match(expr.value.id):
+            d = f"{expr.value.id}.{expr.attr}"
+            return Taint(KIND_SIZE, (self._step(expr, f"request field {d}"),))
+        return None
+
+    # --------------------------------------------------------------- calls
+
+    def eval_call(self, call: ast.Call, env: dict[str, Taint]) -> Taint | None:
+        if id(call) in self._decorators:
+            return None
+        fd = dotted(call.func) or ""
+        last = fd.rsplit(".", 1)[-1]
+
+        if self.sf.is_barrier(call, self.fn):
+            for a in call.args:
+                self.eval(a, env)
+            for kw in call.keywords:
+                self.eval(kw.value, env)
+            return None  # laundered: bucketed values are the warmup ladder
+
+        arg_taints = [self.eval(a, env) for a in call.args]
+        kw_taints = {kw.arg: self.eval(kw.value, env) for kw in call.keywords}
+
+        # -- sources -------------------------------------------------------
+        if fd == "len" and call.args:
+            t = arg_taints[0]
+            if t is not None:
+                return t.as_kind(KIND_SIZE, self._step(call, "len() of it"))
+            named = _request_named(call.args[0])
+            if named is not None:
+                return Taint(KIND_SIZE, (self._step(
+                    call, f"len({named}) is request-derived"),))
+            return None
+        if last == "qsize":
+            return Taint(KIND_SIZE, (self._step(call, f"{fd}() queue depth"),))
+
+        # -- passthrough ---------------------------------------------------
+        if fd in _PASSTHROUGH_BUILTINS:
+            return _join(*arg_taints)
+        if last in ("min", "max") and "." not in fd:
+            return _join(*arg_taints, *kw_taints.values())
+
+        root = fd.split(".")[0]
+
+        # -- array creation / shape sinks ---------------------------------
+        if last in _CREATION_NAMES or last == "full":
+            shape_taints = self._shape_arg_taints(call, env, first_arg=True)
+            hit = _join(*[t for _, t in shape_taints])
+            if hit is not None and hit.kind == KIND_SIZE:
+                if root in _DEVICE_ROOTS:
+                    self._shp001(call, hit, f"{fd}() device-array shape")
+                    return hit.as_kind(KIND_ARRAY, self._step(
+                        call, f"{fd}() allocates a request-shaped array"))
+                if root in _HOST_ROOTS:
+                    return hit.as_kind(KIND_ARRAY, self._step(
+                        call, f"{fd}() allocates a request-shaped host array"))
+            return None
+        if last in _RESHAPEISH:
+            shape_taints = self._shape_arg_taints(call, env, first_arg=(root in
+                                                  _DEVICE_ROOTS | _HOST_ROOTS))
+            hit = _join(*[t for _, t in shape_taints])
+            recv = None
+            if isinstance(call.func, ast.Attribute) and root not in (
+                    _DEVICE_ROOTS | _HOST_ROOTS):
+                recv = self.eval(call.func.value, env)
+            if hit is not None and hit.kind == KIND_SIZE:
+                if root in _HOST_ROOTS or (
+                        recv is not None and recv.kind == KIND_ARRAY):
+                    return hit.as_kind(KIND_ARRAY, self._step(
+                        call, f"{last}() to a request-derived shape"))
+                self._shp001(call, hit, f"{fd}() new shape")
+                return hit.as_kind(KIND_ARRAY, self._step(
+                    call, f"{fd}() to a request-derived shape"))
+            return recv
+        if last == "ShapeDtypeStruct":
+            hit = _join(*[t for _, t in self._shape_arg_taints(call, env,
+                                                               first_arg=True)])
+            if hit is not None and hit.kind == KIND_SIZE:
+                self._shp001(call, hit, "ShapeDtypeStruct shape")
+            return None
+        if last == "BlockSpec":
+            hit = _join(*arg_taints, *kw_taints.values())
+            if hit is not None and hit.kind == KIND_SIZE:
+                self._shp001(call, hit, "Pallas BlockSpec geometry")
+            return None
+        if last == "pallas_call":
+            for key in ("grid", "out_shape", "in_specs", "out_specs", "grid_spec"):
+                t = kw_taints.get(key)
+                if t is not None and t.kind == KIND_SIZE:
+                    self._shp001(call, t, f"pallas_call {key}=")
+            return None
+        if last in _ASARRAYISH:
+            return _join(*arg_taints, *kw_taints.values())
+
+        # -- jitted dispatch ----------------------------------------------
+        spec, callee_fi, jit_name = self.sf.jit_spec_for_call(call, self.fn)
+        if spec is not None:
+            self._check_jit_dispatch(call, spec, callee_fi, jit_name,
+                                     arg_taints, kw_taints, env)
+            return None
+
+        # -- ordinary in-repo call: propagate into callee ------------------
+        callees = self.sf._resolve(call, self.fn)
+        ret: Taint | None = None
+        for fi in callees:
+            params = _params_of(fi)
+            offset = 1 if params[:1] in (["self"], ["cls"]) and isinstance(
+                call.func, ast.Attribute) else 0
+            for i, t in enumerate(arg_taints):
+                if t is None:
+                    continue
+                pi = i + offset
+                if pi < len(params):
+                    self.sf.record_call_taint(fi, params[pi], t.extend(
+                        self._step(call, f"passed to {fi.name}({params[pi]}=…)")))
+            for kw, t in kw_taints.items():
+                if t is not None and kw in params:
+                    self.sf.record_call_taint(fi, kw, t.extend(
+                        self._step(call, f"passed to {fi.name}({kw}=…)")))
+            rt = self.sf.ret_taint.get(id(fi))
+            if rt is not None:
+                ret = _join(ret, rt.extend(self._step(
+                    call, f"returned by {fi.name}()")))
+        return ret
+
+    def _shape_arg_taints(self, call: ast.Call, env: dict[str, Taint],
+                          first_arg: bool) -> list[tuple[ast.AST, Taint]]:
+        """Taints of shape-position components (tuple elements unpacked)."""
+        out: list[tuple[ast.AST, Taint]] = []
+
+        def add(e: ast.AST) -> None:
+            if isinstance(e, (ast.Tuple, ast.List)):
+                for elt in e.elts:
+                    add(elt)
+                return
+            t = self.eval(e, env)
+            if t is not None:
+                out.append((e, t))
+
+        exprs: list[ast.AST] = []
+        if first_arg and call.args:
+            exprs.append(call.args[0])
+        else:
+            exprs.extend(call.args)
+        for kw in call.keywords:
+            if kw.arg in ("shape", "new_sizes", "pad_width"):
+                exprs.append(kw.value)
+        for e in exprs:
+            add(e)
+        return out
+
+    def _check_jit_dispatch(self, call: ast.Call, spec: JitSpec,
+                            callee_fi: FuncInfo | None, jit_name: str,
+                            arg_taints: list[Taint | None],
+                            kw_taints: dict[str | None, Taint | None],
+                            env: dict[str, Taint]) -> None:
+        params: list[str] = []
+        offset = 0
+        if callee_fi is not None:
+            params = _params_of(callee_fi)
+            if params[:1] in (["self"], ["cls"]) and isinstance(
+                    call.func, ast.Attribute):
+                offset = 1
+
+        def is_static(idx: int | None, name: str | None) -> bool:
+            if name is not None and name in spec.static_names:
+                return True
+            if idx is not None:
+                if idx in spec.static_nums:
+                    return True
+                pi = idx + offset
+                if params and pi < len(params) and params[pi] in spec.static_names:
+                    return True
+            return False
+
+        for i, t in enumerate(arg_taints):
+            pname = params[i + offset] if params and i + offset < len(params) else None
+            if t is not None and is_static(i, pname):
+                self._shp001(call, t, f"static argument "
+                             f"{pname or ('#%d' % i)} of jitted {jit_name}")
+            elif t is not None and t.kind == KIND_ARRAY and not is_static(i, pname):
+                self._shp001(
+                    call, t,
+                    f"traced argument of jitted {jit_name} (its shape keys "
+                    f"the compile)")
+            self._check_weak_type(call.args[i], env, call, jit_name,
+                                  static=is_static(i, pname))
+        for kw in call.keywords:
+            t = kw_taints.get(kw.arg)
+            if t is not None and is_static(None, kw.arg):
+                self._shp001(call, t, f"static argument {kw.arg} of jitted {jit_name}")
+            elif t is not None and t.kind == KIND_ARRAY:
+                self._shp001(
+                    call, t,
+                    f"traced argument {kw.arg} of jitted {jit_name} (its "
+                    f"shape keys the compile)")
+            self._check_weak_type(kw.value, env, call, jit_name,
+                                  static=is_static(None, kw.arg))
+
+    def _check_weak_type(self, arg: ast.AST, env: dict[str, Taint],
+                         call: ast.Call, jit_name: str, static: bool) -> None:
+        """SHP004: literal ⊕ config-dtype operand in a traced argument."""
+        if static or not self.emit or not isinstance(arg, ast.BinOp):
+            return
+        sides = [arg.left, arg.right]
+        has_literal = any(isinstance(s, ast.Constant)
+                          and isinstance(s.value, (int, float))
+                          and not isinstance(s.value, bool) for s in sides)
+        if not has_literal:
+            return
+        for s in sides:
+            if isinstance(s, ast.Constant):
+                continue
+            d = dotted(s) or ""
+            srctxt = self._src(s)
+            if _CONFIG_DTYPE_RE.search(d) or ".astype(" in srctxt:
+                self.sf.emit(
+                    self.fn, call, "SHP004",
+                    f"Python scalar literal mixed with config-dtyped operand "
+                    f"'{srctxt}' in a traced argument of jitted {jit_name} — "
+                    f"the literal's weak type resolves per config and keys "
+                    f"dtype recompiles; wrap the literal in the operand's "
+                    f"dtype (e.g. `jnp.asarray(c, x.dtype)`)")
+                return
+
+    def _shp001(self, call: ast.Call, taint: Taint, sink: str) -> None:
+        if not self.emit:
+            return
+        chain = taint.chain + (self._step(call, f"reaches {sink}"),)
+        self.sf.emit(
+            self.fn, call, "SHP001",
+            f"request-derived size reaches {sink} with no bucketing barrier "
+            f"on the path — every new value compiles a fresh XLA program on "
+            f"the serving path; route it through next_bucket()/a ladder "
+            f"helper or annotate the laundering call with `# tpulint: "
+            f"bucket`. Taint: " + " -> ".join(chain),
+            chain=chain)
+
+
+# --------------------------------------------------------------------------
+# SHP002: warmup coverage over the dispatch-site graph
+
+def _ordinary_reach(sf: ShapeFlow, roots: list[FuncInfo]) -> set[int]:
+    seen: set[int] = set()
+    stack = list(roots)
+    while stack:
+        fn = stack.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        for edge in sf.program._edges_by_caller.get(id(fn), ()):
+            stack.append(edge.callee)
+        stack.extend(sf.ref_edges.get(id(fn), ()))
+    return seen
+
+
+def _dispatch_sites(sf: ShapeFlow) -> dict[int, tuple[FuncInfo, int, str]]:
+    """fn-id -> (fn, line, jit name) for functions containing a jit dispatch."""
+    out: dict[int, tuple[FuncInfo, int, str]] = {}
+    for fn in sf.program.functions:
+        if sf.is_jitted(fn):
+            continue
+        for node in _walk_own(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            spec, _, jit_name = sf.jit_spec_for_call(node, fn)
+            if spec is not None:
+                out.setdefault(id(fn), (fn, node.lineno, jit_name))
+                break
+    return out
+
+
+def _uses_barrier(sf: ShapeFlow, fn: FuncInfo) -> bool:
+    return any(isinstance(n, ast.Call) and sf.is_barrier(n, fn)
+               for n in _walk_own(fn.node))
+
+
+def _check_shp002(sf: ShapeFlow) -> list[ProgramFinding]:
+    program = sf.program
+    sites = _dispatch_sites(sf)
+    warm_roots = [fn for fn in program.functions
+                  if _WARMUP_NAME_RE.search(fn.name)]
+    warmed = _ordinary_reach(sf, warm_roots)
+    # jitted callees some warmup-reachable code dispatches: a live site is
+    # also covered when warmup drives the SAME jitted program, even through
+    # a different wrapper (warmup() calling embed directly covers encode()'s
+    # embed dispatch — it is the compile cache that matters, not the caller)
+    warmed_jits: set[str] = set()
+    for fn in program.functions:
+        if id(fn) not in warmed or sf.is_jitted(fn):
+            continue
+        for node in _walk_own(fn.node):
+            if isinstance(node, ast.Call):
+                spec, _, jn = sf.jit_spec_for_call(node, fn)
+                if spec is not None:
+                    warmed_jits.add(jn)
+    findings: list[ProgramFinding] = []
+    flagged: set[int] = set()
+    for ci in sorted(program.classes.values(), key=lambda c: c.qualname):
+        hot = [m for name, m in sorted(ci.methods.items())
+               if _HOT_NAME_RE.search(name) and not _WARMUP_NAME_RE.search(name)
+               and name != "__init__"]
+        if not hot:
+            continue
+        has_warmup = any(_WARMUP_NAME_RE.search(name) for name in ci.methods)
+        live = _ordinary_reach(sf, hot)
+        live_sites = [sites[i] for i in live if i in sites]
+        uncovered = [(fn, line, jn) for fn, line, jn in live_sites
+                     if id(fn) not in warmed and jn not in warmed_jits]
+        if has_warmup:
+            for fn, line, jit_name in sorted(uncovered,
+                                             key=lambda t: t[0].qualname):
+                if id(fn) in flagged:
+                    continue
+                flagged.add(id(fn))
+                findings.append(ProgramFinding(
+                    fn.module.path, line, 0, "SHP002",
+                    f"jit dispatch of {jit_name} in '{fn.qualname}' is "
+                    f"reachable from {ci.qualname}'s live path but from no "
+                    f"warmup routine — the first real request pays the XLA "
+                    f"compile; extend warmup to drive this site over its "
+                    f"bucket ladder"))
+        elif uncovered:
+            # no warmup at all: flag only when the live path shows bucket
+            # discipline (a barrier call) — that is the signature of a
+            # serving-path class whose ladder now compiles under traffic
+            live_fns = [f for f in program.functions if id(f) in live]
+            if not any(_uses_barrier(sf, f) for f in live_fns):
+                continue
+            if id(ci.node) in flagged:
+                continue
+            flagged.add(id(ci.node))
+            fn, line, jit_name = sorted(uncovered, key=lambda t: t[0].qualname)[0]
+            findings.append(ProgramFinding(
+                ci.module.path, ci.node.lineno, ci.node.col_offset, "SHP002",
+                f"class {ci.qualname} runs bucketed jit dispatches on its "
+                f"live path (e.g. {jit_name} in '{fn.qualname}') but defines "
+                f"no warmup routine — the whole ladder compiles under live "
+                f"traffic; add a warmup() that precompiles it"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# SHP003: jit/pallas constructed in per-step scope
+
+def _check_shp003(sf: ShapeFlow) -> list[ProgramFinding]:
+    program = sf.program
+    hot_roots = [fn for fn in program.functions
+                 if _HOT_NAME_RE.search(fn.name)
+                 and not _WARMUP_NAME_RE.search(fn.name)
+                 and not _FACTORY_NAME_RE.search(fn.name)]
+    hot_reach = _ordinary_reach(sf, hot_roots)
+    # helpers reached from a jitted function construct pallas_call at trace
+    # time only — the enclosing jit caches the trace, so that's the idiom
+    traced_reach = _ordinary_reach(
+        sf, [f for f in program.functions if sf.is_jitted(f)])
+    findings: list[ProgramFinding] = []
+    for fn in sorted(program.functions, key=lambda f: f.qualname):
+        if id(fn) not in hot_reach or sf.is_jitted(fn):
+            continue
+        if _FACTORY_NAME_RE.search(fn.name) or _WARMUP_NAME_RE.search(fn.name):
+            continue
+        deco_ids = {id(s) for d in (getattr(fn.node, "decorator_list", None) or [])
+                    for s in ast.walk(d)}
+        memoized: set[int] = set()
+        for node in _walk_own(fn.node):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id in ("self", "cls") for t in node.targets):
+                for sub in ast.walk(node.value):
+                    memoized.add(id(sub))
+        for node in _walk_own(fn.node):
+            if not isinstance(node, ast.Call) or id(node) in deco_ids:
+                continue
+            if id(node) in memoized:
+                continue  # self._f = jax.jit(...) memoization is the fix
+            what = None
+            if jit_spec_of(node) is not None:
+                what = "jax.jit"
+            elif (dotted(node.func) or "").rsplit(".", 1)[-1] == "pallas_call":
+                if id(fn) not in traced_reach:
+                    what = "pallas_call"
+            if what is None:
+                continue
+            findings.append(ProgramFinding(
+                fn.module.path, node.lineno, node.col_offset, "SHP003",
+                f"{what} constructed inside '{fn.qualname}', which runs on "
+                f"the per-request/per-step path — each call builds a fresh "
+                f"compile cache, so nothing is ever reused; hoist it to "
+                f"module scope, a make_*/build_* factory, or memoize it on "
+                f"self"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# registration + entry point
+
+_register_program_rule(
+    "SHP001",
+    "request-derived size reaches a jit shape position unbucketed",
+    "An integer traced back to request data (len(prompt), queue depth, k) "
+    "reaches a shape position — jnp.zeros/full/pad/reshape/broadcast_to, a "
+    "static argument of a jitted callee, a Pallas grid/BlockSpec — or a "
+    "request-shaped host array is traced by a jitted callee, with no "
+    "bucketing barrier on the path. Every new value compiles a fresh XLA "
+    "program under live traffic. The finding message carries the full "
+    "source-to-sink taint chain.",
+)
+_register_program_rule(
+    "SHP002",
+    "jit dispatch on the live path is not covered by warmup",
+    "The warmup-coverage contract: every jit dispatch site reachable from "
+    "a class's hot-path methods must be reachable from a warmup routine "
+    "too, and a class running bucketed dispatches must define warmup at "
+    "all. A ladder value used in traffic but absent from warmup is a "
+    "latent live compile.",
+)
+_register_program_rule(
+    "SHP003",
+    "jit/pallas_call constructed in per-request scope",
+    "jax.jit, functools.partial(jax.jit, ...) or pallas_call is "
+    "constructed inside a function on the per-request/per-step path. The "
+    "compile cache lives on the returned wrapper, so a fresh wrapper per "
+    "call recompiles every time. Factories (make_*/build_*/__init__) and "
+    "self-attribute memoizations are exempt.",
+)
+_register_program_rule(
+    "SHP004",
+    "weak-type literal mixed with config-dtyped jitted operand",
+    "A bare Python scalar in a traced argument's arithmetic adopts the "
+    "other operand's dtype, and that dtype follows configuration "
+    "(kv_quant scales and friends) — so flipping config silently keys "
+    "dtype-differentiated recompiles. Cast the literal explicitly.",
+)
+
+
+def run_shapeflow(program: Program) -> list[ProgramFinding]:
+    """Run the shape-provenance pass over a built Program."""
+    return ShapeFlow(program).run()
