@@ -115,6 +115,37 @@ def decode_extras(tps: float, batch: int, weight_bytes: int) -> dict:
             "roofline_pct": round(100.0 * gbps / HBM_GBPS_V5E, 1)}
 
 
+def slo_extras(engine, before: dict | None, wall_s: float) -> dict:
+    """Token-economics extras for a scenario emit — the same quantities the
+    serving SLO plane derives live (obs/ledger.py): goodput (committed
+    tok/s over the scenario wall), MFU against the configured chip peak,
+    and wasted tokens (spec-rejected + deadline-reaped).  ``before`` is an
+    ``engine_snapshot`` taken at scenario start (None = engine was fresh)."""
+    from githubrepostorag_tpu.config import get_settings
+    from githubrepostorag_tpu.obs.ledger import engine_snapshot, flops_per_token
+
+    after = engine_snapshot(engine)
+    before = before or {}
+    d = {k: after[k] - before.get(k, 0.0) for k in after}
+    committed = max(0.0, d["committed_tokens"])
+    rejected = max(0.0, d["spec_proposed"] - d["spec_accepted"])
+    reaped = max(0.0, d["reaped_tokens"])
+    wasted = rejected + reaped
+    wall = max(wall_s, 1e-9)
+    s = get_settings()
+    fpt = s.model_flops_per_token or (
+        flops_per_token(engine.cfg) if getattr(engine, "cfg", None) else 0.0)
+    mfu = ((committed + max(0.0, d["prefill_tokens"])) * fpt
+           / (wall * s.chip_peak_tflops * 1e12))
+    return {
+        "goodput_tok_s": round(committed / wall, 1),
+        "mfu": round(mfu, 6),
+        "wasted_tokens": int(wasted),
+        "wasted_token_fraction": round(
+            wasted / max(1.0, committed + wasted), 4),
+    }
+
+
 def streamed_nbytes(params) -> int:
     """Weight bytes a decode step actually STREAMS: the full tree minus the
     input-embedding table when an untied lm_head exists (decode only
@@ -210,7 +241,8 @@ def bench_decode(cfg, tag: str, *, batch: int, prompt_len: int, gen_tokens: int,
         decode_t = max(max(r.decode_time_s for r in results), 1e-9)
         decode_toks = sum(max(len(r.output_tokens) - 1, 0) for r in results)
         ttfts = sorted(r.ttft_s for r in results if r.ttft_s is not None)
-        return decode_toks / decode_t, ttfts[len(ttfts) // 2], wall
+        return (decode_toks / decode_t, ttfts[len(ttfts) // 2], wall,
+                slo_extras(eng, None, wall))
 
     log(f"bench[{tag}]: warmup (compile)")
     try:
@@ -224,6 +256,9 @@ def bench_decode(cfg, tag: str, *, batch: int, prompt_len: int, gen_tokens: int,
     samples = [run(use_pallas) for _ in range(runs)]
     tps = median(s[0] for s in samples)
     ttft = median(s[1] for s in samples)
+    ex = dict(samples[-1][3])
+    emit(f"decode_goodput_tok_s_{tag}", ex.pop("goodput_tok_s"), "tok/s",
+         None, **ex)
     log(f"bench[{tag}]: median decode {tps:.1f} tok/s, p50 TTFT {ttft:.3f}s "
         f"over {runs} runs: {[round(s[0], 1) for s in samples]} pallas={use_pallas}")
     return tps, ttft, params
@@ -237,6 +272,9 @@ def _timed_generate(engine, prompts, sp):
     toward the prompt wave; the rest is decode.  ``max_step_s`` exposes a
     mid-run stall (an uncached XLA compile through the tunnel costs tens of
     seconds; a healthy 7B step is ~30 ms)."""
+    from githubrepostorag_tpu.obs.ledger import engine_snapshot
+
+    snap0 = engine_snapshot(engine)
     order = [engine.add_request(p, sp) for p in prompts]
     done: dict = {}
     prompt_wave = decode_wall = max_step = 0.0
@@ -258,7 +296,8 @@ def _timed_generate(engine, prompts, sp):
     phases = {"wall_s": round(wall, 3), "n_steps": n_steps,
               "max_step_s": round(max_step, 3),
               "prompt_wave_s": round(prompt_wave, 3),
-              "decode_wall_s": round(decode_wall, 3)}
+              "decode_wall_s": round(decode_wall, 3),
+              **slo_extras(engine, snap0, wall)}
     return [done[rid] for rid in order], phases
 
 
@@ -319,6 +358,38 @@ def _tracing_overhead_pct(wall_s: float, n_requests: int,
             100.0 * off_cost * total / max(wall_s, 1e-9))
 
 
+def _slo_overhead_pct(wall_s: float, n_steps: int, n_requests: int) -> float:
+    """Estimated SLO-plane overhead as a % of the scenario wall: measured
+    per-call cost of the driver's two hot-loop obs calls — the token
+    ledger's ``on_step`` (snapshot diff + rolling sums + gauge publish)
+    once per engine step, and the burn-rate monitor's ``observe`` (event
+    append + forced multi-window refresh) once per finished request."""
+    from githubrepostorag_tpu.obs.ledger import SNAPSHOT_FIELDS, TokenLedger
+    from githubrepostorag_tpu.obs.slo import SLOMonitor
+
+    ledger = TokenLedger("bench-overhead", flops_per_tok=1e9,
+                         peak_flops=1e12, window_s=60.0)
+    snap = {f: 0.0 for f in SNAPSHOT_FIELDS}
+    N = 2000
+    base = time.monotonic()
+    t0 = time.monotonic()
+    for i in range(N):
+        snap["committed_tokens"] += 8.0
+        snap["decode_seconds_total"] += 1e-3
+        t = base + i * 1e-3
+        ledger.on_step(dict(snap), t, t + 8e-4)
+    step_cost = (time.monotonic() - t0) / N
+    monitor = SLOMonitor("bench-overhead")
+    M = 500
+    t0 = time.monotonic()
+    for i in range(M):
+        monitor.observe(ttft_s=0.01, tpot_s=0.01, deadline_missed=False,
+                        now=base + i * 1e-2)
+    observe_cost = (time.monotonic() - t0) / M
+    total = step_cost * max(1, n_steps) + observe_cost * max(1, n_requests)
+    return 100.0 * total / max(wall_s, 1e-9)
+
+
 def bench_concurrency(cfg, *, streams: int, prompt_len, gen_tokens: int,
                       engine, trials: int = 1,
                       seed0: int = 1) -> tuple[float, float, dict]:
@@ -371,6 +442,15 @@ def bench_concurrency(cfg, *, streams: int, prompt_len, gen_tokens: int,
         raise RuntimeError(
             f"tracing overhead {on_pct:.2f}% of scenario wall exceeds the "
             "2% budget (span fast path regressed?)"
+        )
+    slo_pct = _slo_overhead_pct(phases["wall_s"], phases["n_steps"], streams)
+    phases["slo_overhead_pct"] = round(slo_pct, 4)
+    if slo_pct > 2.0:
+        # same budget for the SLO plane: the ledger/monitor ride the driver
+        # hot loop and must not cost the goodput they account for
+        raise RuntimeError(
+            f"SLO ledger+monitor overhead {slo_pct:.2f}% of scenario wall "
+            "exceeds the 2% budget (on_step/observe fast path regressed?)"
         )
     return agg, p50, phases
 
@@ -785,17 +865,25 @@ def bench_spec_pair(tag: str, *, streams: int = 8, prompt_len: int = 32,
         p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))]
         return toks / wall, p95, [r.output_tokens for r in res]
 
+    from githubrepostorag_tpu.obs.ledger import engine_snapshot
+
     out, toks_by_path = {}, {}
     for path, eng in engines.items():
         run(eng)  # untimed warm pass compiles the shape ladder
+        snap0 = engine_snapshot(eng)
+        t0 = time.monotonic()
         samples = [run(eng) for _ in range(trials)]
+        trials_wall = time.monotonic() - t0
         tps = median(s[0] for s in samples)
         p95 = median(s[1] for s in samples)
         toks_by_path[path] = samples[-1][2]
         out[path] = (tps, p95)
+        ex = slo_extras(eng, snap0, trials_wall)
         emit(f"{tag}_agg_tok_s_{path}", tps, "tok/s", None,
              trial_tok_s=[round(s[0], 1) for s in samples])
         emit(f"{tag}_ttft_p95_ms_{path}", p95 * 1e3, "ms", None)
+        emit(f"{tag}_goodput_tok_s_{path}", ex.pop("goodput_tok_s"),
+             "tok/s", None, **ex)
         log(f"bench[{tag}]: {path} {tps:.0f} tok/s agg, TTFT p95 "
             f"{p95 * 1e3:.2f} ms ({streams} streams x {gen_tokens} tokens)")
     # the gate: speculation is a scheduling change, never a token change
@@ -895,12 +983,20 @@ def bench_kv_tier_pair(tag: str, *, waves=(48, 48, 32), prefix_len: int = 48,
         p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))]
         return peak, p95, per_phase, outputs
 
+    from githubrepostorag_tpu.obs.ledger import engine_snapshot
+
     out: dict[str, tuple] = {}
     for path, eng in engines.items():
+        snap0 = engine_snapshot(eng)
+        t0 = time.monotonic()
         peak, p95, per_phase, outputs = run(eng)
+        run_wall = time.monotonic() - t0
         out[path] = (peak, p95, per_phase, outputs)
+        ex = slo_extras(eng, snap0, run_wall)
         emit(f"{tag}_peak_concurrency_{path}", peak, "rows", None)
         emit(f"{tag}_ttft_p95_ms_{path}", p95 * 1e3, "ms", None)
+        emit(f"{tag}_goodput_tok_s_{path}", ex.pop("goodput_tok_s"),
+             "tok/s", None, **ex)
         # the same quantity a /debug/traces reader sees: spans rebuilt from
         # each result's timings through the flight recorder, with the
         # kv_fault_in events riding the prefill spans

@@ -113,6 +113,8 @@ class RagApi:
         app.router.add_get("/rag/jobs/{job_id}/result", self.job_result)
         app.router.add_get("/debug/traces", self.debug_traces)
         app.router.add_get("/debug/traces/{trace_id}", self.debug_trace)
+        app.router.add_get("/debug/slo", self.debug_slo)
+        app.router.add_get("/debug/fleet", self.debug_fleet)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/", self.index_redirect)
@@ -168,6 +170,18 @@ class RagApi:
                 {"error": f"job queue full ({depth} queued); retry later"},
                 status=429,
                 headers={"Retry-After": str(retry_after)},
+            )
+        # SLO-plane admission hint: a critical burn rate sheds BEFORE the
+        # queue saturates — rejecting now is cheaper than timing out later
+        # (the burn only worsens if the backlog keeps growing)
+        from githubrepostorag_tpu.resilience.admission import should_shed
+
+        if should_shed():
+            JOBS_SHED.inc()
+            return web.json_response(
+                {"error": "SLO burn rate critical; shedding load, retry later"},
+                status=429,
+                headers={"Retry-After": "1"},
             )
         job_id = uuid.uuid4().hex
         cap_ms = s.job_timeout_seconds * 1000
@@ -271,6 +285,16 @@ class RagApi:
             return web.json_response({"error": "unknown trace (evicted or never recorded)"},
                                      status=404)
         return web.json_response(payload)
+
+    async def debug_slo(self, request: web.Request) -> web.Response:
+        from githubrepostorag_tpu.obs.slo import get_slo_plane
+
+        return web.json_response(get_slo_plane().slo_payload())
+
+    async def debug_fleet(self, request: web.Request) -> web.Response:
+        from githubrepostorag_tpu.obs.slo import get_slo_plane
+
+        return web.json_response(get_slo_plane().fleet_payload())
 
     async def health(self, request: web.Request) -> web.Response:
         import asyncio

@@ -163,6 +163,28 @@ class Settings:
     trace_max_spans: int = field(default_factory=lambda: _env_int("TRACE_MAX_SPANS", 128))
     # json (trace-stamped structured lines) | plain (human format)
     log_format: str = field(default_factory=lambda: os.getenv("LOG_FORMAT", "json"))
+    # --- SLO plane (obs/slo.py) + token ledger (obs/ledger.py) ---
+    # objectives per priority class; thresholds in ms.  p50 objective gets a
+    # 50% error budget (median), p99 a 1% budget, deadline-miss its own budget
+    slo_ttft_p50_ms: float = field(default_factory=lambda: _env_float("SLO_TTFT_P50_MS", 1500.0))
+    slo_ttft_p99_ms: float = field(default_factory=lambda: _env_float("SLO_TTFT_P99_MS", 5000.0))
+    slo_tpot_ms: float = field(default_factory=lambda: _env_float("SLO_TPOT_MS", 100.0))
+    slo_deadline_miss_budget: float = field(
+        default_factory=lambda: _env_float("SLO_DEADLINE_MISS_BUDGET", 0.05))
+    # "short,long" rolling windows in seconds for multi-window burn rates
+    slo_windows: str = field(default_factory=lambda: os.getenv("SLO_WINDOWS", "60,300"))
+    # burn-rate thresholds (SRE canonical 14.4x/6x); a state transition fires
+    # only when BOTH windows cross — the short window alone is too noisy
+    slo_burn_warn: float = field(default_factory=lambda: _env_float("SLO_BURN_WARN", 6.0))
+    slo_burn_critical: float = field(default_factory=lambda: _env_float("SLO_BURN_CRITICAL", 14.4))
+    # token-ledger rolling window for goodput / MFU / limiter attribution
+    slo_ledger_window_s: float = field(default_factory=lambda: _env_float("SLO_LEDGER_WINDOW_S", 60.0))
+    # static FLOPs/token for MFU; 0 = derive ~2x param count from the model
+    # config at engine construction (dense approximation, good to ~5%)
+    model_flops_per_token: float = field(
+        default_factory=lambda: _env_float("MODEL_FLOPS_PER_TOKEN", 0.0))
+    # peak per-chip TFLOPs for the MFU denominator (v5e bf16 = 197)
+    chip_peak_tflops: float = field(default_factory=lambda: _env_float("CHIP_PEAK_TFLOPS", 197.0))
 
     # --- Worker ---
     default_namespace: str = field(default_factory=lambda: os.getenv("DEFAULT_NAMESPACE", "default"))

@@ -48,7 +48,7 @@ def _push_stage_gauge(stage: str, seconds: float, grouping: dict[str, str]) -> N
         from prometheus_client import CollectorRegistry, Gauge, push_to_gateway
 
         registry = CollectorRegistry()
-        gauge = Gauge(
+        gauge = Gauge(  # tpulint: disable=OBS002 -- pushgateway pattern: fresh ephemeral registry per push, discarded after push_to_gateway; nothing accumulates
             "ingest_stage_duration_seconds", "Wall-clock of one ingest stage",
             ["stage"], registry=registry,
         )

@@ -60,34 +60,45 @@ DEVICE_INDEX_SEARCHES = Counter(
     ["path"],
     registry=REGISTRY,
 )
+# Engine-owned series carry a `replica` label: under MultiAsyncEngine each
+# AsyncEngine driver binds its own child (r0, r1, ...) so dp>1 fleets write
+# distinct series instead of aliasing one; fleet totals are the label sum
+# (counter_value() sums across label sets).  MeteredLLM's API-side TTFT /
+# token observations use replica="api" — they measure the worker's view
+# through the whole stack, not one engine's step loop.
 TTFT = Histogram(
-    "rag_ttft_seconds", "Time to first generated token", registry=REGISTRY,
+    "rag_ttft_seconds", "Time to first generated token", ["replica"], registry=REGISTRY,
     buckets=(0.1, 0.25, 0.5, 1.0, 1.5, 2.0, 3.0, 5.0, 10.0),
 )
-DECODE_TOKENS = Counter("rag_decode_tokens_total", "Generated tokens", registry=REGISTRY)
-ENGINE_RUNNING = Gauge("rag_engine_running_seqs", "Sequences in the decode batch", registry=REGISTRY)
-ENGINE_WAITING = Gauge("rag_engine_waiting_seqs", "Queued requests", registry=REGISTRY)
+DECODE_TOKENS = Counter("rag_decode_tokens_total", "Generated tokens", ["replica"], registry=REGISTRY)
+ENGINE_RUNNING = Gauge("rag_engine_running_seqs", "Sequences in the decode batch", ["replica"], registry=REGISTRY)
+ENGINE_WAITING = Gauge("rag_engine_waiting_seqs", "Queued requests", ["replica"], registry=REGISTRY)
 PREFIX_CACHE_HITS = Counter(
     "rag_prefix_cache_hit_tokens_total",
     "Prompt tokens served from the KV prefix cache instead of prefill",
+    ["replica"],
     registry=REGISTRY,
 )
 PACKED_PREFILL_TOKENS = Counter(
     "rag_packed_prefill_tokens_total",
     "Real prompt tokens dispatched by the token-budget packed prefill",
+    ["replica"],
     registry=REGISTRY,
 )
 PACKED_PREFILL_PADDING = Counter(
     "rag_packed_prefill_padding_total",
     "Unused packed-prefill budget slots (buffer padding dispatched)",
+    ["replica"],
     registry=REGISTRY,
 )
 SPEC_PROPOSED = Counter(
-    "rag_spec_draft_tokens_total", "Speculative draft tokens proposed", registry=REGISTRY
+    "rag_spec_draft_tokens_total", "Speculative draft tokens proposed",
+    ["replica"], registry=REGISTRY
 )
 SPEC_ACCEPTED = Counter(
     "rag_spec_accepted_tokens_total",
     "Speculative draft tokens the model accepted and committed",
+    ["replica"],
     registry=REGISTRY,
 )
 # literal-name aliases for the draft-model speculation dashboards (the
@@ -96,23 +107,26 @@ SPEC_ACCEPTED = Counter(
 SPEC_PROPOSED_TOTAL = Counter(
     "rag_spec_proposed_total",
     "Draft tokens proposed by the speculative decoder (n-gram or draft model)",
+    ["replica"],
     registry=REGISTRY,
 )
 SPEC_ACCEPTED_TOTAL = Counter(
     "rag_spec_accepted_total",
     "Proposed draft tokens the target model accepted and committed",
+    ["replica"],
     registry=REGISTRY,
 )
 SPEC_FALLBACKS = Counter(
     "rag_spec_fallbacks_total",
     "Requests the adaptive controller demoted from speculative to plain "
     "decode, by reason (acceptance collapse / deadline pressure)",
-    ["reason"],
+    ["replica", "reason"],
     registry=REGISTRY,
 )
 SPEC_ACCEPTANCE = Histogram(
     "rag_spec_acceptance_ratio",
     "Per-request draft acceptance ratio (accepted / proposed) at completion",
+    ["replica"],
     registry=REGISTRY,
     buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
 )
@@ -155,17 +169,20 @@ BREAKER_TRANSITIONS = Counter(
 ENGINE_DEADLINE_REAPS = Counter(
     "rag_engine_deadline_reaps_total",
     "Generation requests reaped at a step boundary for exceeding their deadline",
+    ["replica"],
     registry=REGISTRY,
 )
 XLA_COMPILES = Counter(
     "rag_xla_compiles_total",
     "Fresh XLA compilations observed during live engine stepping "
     "(warmup should make this zero; see obs/engine_profile.py)",
+    ["replica"],
     registry=REGISTRY,
 )
 TPOT = Histogram(
     "rag_engine_tpot_seconds",
     "Time per output token after the first (decode seconds / decode tokens)",
+    ["replica"],
     registry=REGISTRY,
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
 )
@@ -173,47 +190,107 @@ SCHED_STALL = Gauge(
     "rag_engine_sched_stall_seconds",
     "Gap between consecutive engine steps while work exists "
     "(scheduler stall; 0 when idle)",
+    ["replica"],
     registry=REGISTRY,
 )
 KV_TIER_DEVICE_PAGES = Gauge(
     "rag_kv_tier_device_free_pages",
     "Allocatable device KV pages (free list + evictable cached pages)",
+    ["replica"],
     registry=REGISTRY,
 )
 KV_TIER_HOST_PAGES = Gauge(
     "rag_kv_tier_host_pages",
     "KV pages resident in the host-RAM swap tier (by chain hash)",
+    ["replica"],
     registry=REGISTRY,
 )
 KV_FAULT_INS = Counter(
     "rag_kv_tier_fault_ins_total",
     "Prefix pages re-admitted host->device instead of recomputed",
+    ["replica"],
     registry=REGISTRY,
 )
 KV_WRITEBACKS = Counter(
     "rag_kv_tier_writebacks_total",
     "Cold device pages saved device->host at step boundaries",
+    ["replica"],
     registry=REGISTRY,
 )
 KV_DEDUP_HITS = Counter(
     "rag_kv_tier_dedup_hits_total",
     "share() hits on pages other concurrent requests actively hold "
     "(cross-user prefix-page dedup)",
+    ["replica"],
     registry=REGISTRY,
 )
 KV_DEDUP_HOLDS = Counter(
     "rag_kv_tier_dedup_holds_total",
     "Admissions held one registration for an identical prefix mid-prefill "
     "instead of duplicating its footprint",
+    ["replica"],
     registry=REGISTRY,
 )
 KV_MIGRATION_SECONDS = Histogram(
     "rag_kv_tier_migration_seconds",
     "Per-step host time spent planning/dispatching/landing page migration "
     "(writeback gathers + fault-in scatters)",
+    ["replica"],
     registry=REGISTRY,
     buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
              0.05, 0.1),
+)
+# --- SLO plane: token ledger + burn-rate monitor (obs/ledger.py, obs/slo.py)
+LEDGER_GOODPUT = Gauge(
+    "rag_engine_goodput_tokens_per_s",
+    "Rolling committed-token throughput over the ledger window",
+    ["replica"],
+    registry=REGISTRY,
+)
+LEDGER_MFU = Gauge(
+    "rag_engine_mfu_ratio",
+    "Rolling model FLOPs utilization: (committed+prefill tokens) x "
+    "flops/token over elapsed x peak chip FLOPs",
+    ["replica"],
+    registry=REGISTRY,
+)
+LEDGER_LIMITER = Gauge(
+    "rag_engine_limiter",
+    "One-hot windowed bottleneck attribution "
+    "(hbm_pages | stall | compile | swap_wait | none)",
+    ["replica", "limiter"],
+    registry=REGISTRY,
+)
+LEDGER_STEP_SECONDS = Counter(
+    "rag_engine_step_seconds_total",
+    "Engine step wall time classified into phase buckets "
+    "(prefill | decode | spec_verify | kv_migration | sched_stall | compile)",
+    ["replica", "bucket"],
+    registry=REGISTRY,
+)
+LEDGER_TOKENS = Counter(
+    "rag_engine_tokens_total",
+    "Token outcomes: committed | spec_rejected | deadline_reaped",
+    ["replica", "outcome"],
+    registry=REGISTRY,
+)
+SLO_BURN = Gauge(
+    "rag_slo_burn_rate",
+    "Error-budget burn rate per objective/class over each rolling window",
+    ["replica", "objective", "klass", "window"],
+    registry=REGISTRY,
+)
+SLO_STATE = Gauge(
+    "rag_slo_state",
+    "SLO state machine per objective/class: 0=ok 1=warn 2=critical",
+    ["replica", "objective", "klass"],
+    registry=REGISTRY,
+)
+SLO_TRANSITIONS = Counter(
+    "rag_slo_state_transitions_total",
+    "SLO state machine transitions, labeled by the state entered",
+    ["replica", "objective", "klass", "state"],
+    registry=REGISTRY,
 )
 MOE_ASSIGNMENTS = Counter(
     "rag_moe_expert_assignments_total",
@@ -233,14 +310,18 @@ def render() -> bytes:
 
 def counter_value(metric, **labels) -> float:
     """Read a Counter/Gauge's current value through the public collect()
-    API (tests and the health report; avoids prometheus_client privates)."""
+    API (tests and the health report; avoids prometheus_client privates).
+    Sums every sample matching the given labels, so a partial label set
+    aggregates across the rest — e.g. ``counter_value(DECODE_TOKENS)`` is
+    the fleet total over all replicas."""
     want = {k: str(v) for k, v in labels.items()}
+    total = 0.0
     for sample in metric.collect()[0].samples:
         if sample.name.endswith("_created"):
             continue
         if all(sample.labels.get(k) == v for k, v in want.items()):
-            return sample.value
-    return 0.0
+            total += sample.value
+    return total
 
 
 class MeteredLLM:
@@ -301,14 +382,14 @@ class MeteredLLM:
         try:
             for delta in self._inner.stream_complete(prompt, **kw):
                 if first:
-                    TTFT.observe(time.monotonic() - start)
+                    TTFT.labels(replica="api").observe(time.monotonic() - start)
                     first = False
                 if delta.startswith("Error:"):
                     # backends yield errors as text, never raise — an
                     # "Error:" delta IS the failure signal
                     status = "error"
                 deltas += 1
-                DECODE_TOKENS.inc()
+                DECODE_TOKENS.labels(replica="api").inc()
                 yield delta
         except GeneratorExit:
             status = "cancelled"  # consumer closed the stream early

@@ -22,9 +22,21 @@ from githubrepostorag_tpu.obs.trace import (
     trace_scope,
 )
 from githubrepostorag_tpu.obs.recorder import FlightRecorder, get_recorder, reset_recorder
+from githubrepostorag_tpu.obs.ledger import TokenLedger
+from githubrepostorag_tpu.obs.slo import (
+    SLOMonitor,
+    SLOPlane,
+    get_slo_plane,
+    reset_slo_plane,
+)
 
 __all__ = [
     "FlightRecorder",
+    "SLOMonitor",
+    "SLOPlane",
+    "TokenLedger",
+    "get_slo_plane",
+    "reset_slo_plane",
     "NOOP_SPAN",
     "Span",
     "TraceContext",

@@ -115,8 +115,10 @@ class EngineStepProfiler:
     engine step on the driver thread; in-flight request spans register so
     compile events land on the request that was stalled by them."""
 
-    def __init__(self, watchdog: CompileWatchdog | None = None) -> None:
+    def __init__(self, watchdog: CompileWatchdog | None = None,
+                 replica: str = "r0") -> None:
         self.watchdog = watchdog or CompileWatchdog()
+        self.replica = replica
         self._lock = threading.Lock()
         self._live: dict[int, "Span"] = {}
         self._last_step_end: float | None = None
@@ -149,11 +151,11 @@ class EngineStepProfiler:
             prev = self._last_step_end
             self._last_step_end = step_end
         if prev is not None:
-            SCHED_STALL.set(max(0.0, step_start - prev))
+            SCHED_STALL.labels(replica=self.replica).set(max(0.0, step_start - prev))
 
         delta = self.watchdog.sample()
         if delta > 0:
-            XLA_COMPILES.inc(delta)
+            XLA_COMPILES.labels(replica=self.replica).inc(delta)
             with self._lock:
                 live = list(self._live.values())
             for sp in live:
@@ -172,7 +174,7 @@ class EngineStepProfiler:
             self._last_step_end = None
         from githubrepostorag_tpu.metrics import SCHED_STALL
 
-        SCHED_STALL.set(0.0)
+        SCHED_STALL.labels(replica=self.replica).set(0.0)
 
 
 def record_engine_spans(result: Any, parent: TraceContext | None) -> None:

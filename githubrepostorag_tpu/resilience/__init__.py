@@ -22,9 +22,19 @@ from githubrepostorag_tpu.resilience.faults import (
     reset_faults,
 )
 from githubrepostorag_tpu.resilience.supervise import ResilientBus
+from githubrepostorag_tpu.resilience.admission import (
+    admission_hint,
+    clear_hint_provider,
+    set_hint_provider,
+    should_shed,
+)
 
 __all__ = [
     "CircuitBreaker",
+    "admission_hint",
+    "clear_hint_provider",
+    "set_hint_provider",
+    "should_shed",
     "CircuitOpen",
     "Deadline",
     "DeadlineExceeded",

@@ -1,0 +1,52 @@
+"""Admission hint consulted by the API's load-shedding check.
+
+The SLO plane (obs/slo.py) registers its ``admission_hint`` callable here
+at construction; this module deliberately holds only that callable so
+``resilience`` never imports ``obs`` (no import cycle) and works unchanged
+when no plane exists (standalone workers, unit tests): the default hint is
+"accept".
+
+Hints: "accept" (all SLOs ok) | "throttle" (warn: burn rates elevated on
+both windows) | "shed" (critical: the error budget is burning at a rate
+that exhausts it within hours — reject load now, before the queue does).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+_lock = threading.Lock()
+_provider: Callable[[], str] | None = None
+
+ACCEPT, THROTTLE, SHED = "accept", "throttle", "shed"
+
+
+def set_hint_provider(fn: Callable[[], str]) -> None:
+    global _provider
+    with _lock:
+        _provider = fn
+
+
+def clear_hint_provider() -> None:
+    global _provider
+    with _lock:
+        _provider = None
+
+
+def admission_hint() -> str:
+    """Current fleet admission hint; failure-open (a broken or absent SLO
+    plane must never take the API down with it)."""
+    with _lock:
+        fn = _provider
+    if fn is None:
+        return ACCEPT
+    try:
+        hint = fn()
+    except Exception:  # noqa: BLE001 - hint is advisory, never fatal
+        return ACCEPT
+    return hint if hint in (ACCEPT, THROTTLE, SHED) else ACCEPT
+
+
+def should_shed() -> bool:
+    return admission_hint() == SHED

@@ -33,14 +33,17 @@ class StreamEvent:
 
 
 class AsyncEngine:
-    def __init__(self, engine: Engine) -> None:
+    def __init__(self, engine: Engine, replica: str = "r0") -> None:
         self.engine = engine
+        self.replica = replica
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._queues: dict[str, asyncio.Queue[StreamEvent]] = {}
+        # priority class per in-flight request (SLO monitor dimension)
+        self._priority: dict[str, str] = {}
         # last engine-counter values already exported to prometheus —
         # instance state, so a stop()/start() relaunch doesn't re-export
         # the full cumulative totals
@@ -50,7 +53,26 @@ class AsyncEngine:
                           "kv_dedup": 0, "kv_hold": 0, "kv_mig_s": 0.0}
         # step profiler: scheduler-stall gauge + XLA compile watchdog,
         # sampled once per step on the driver thread (obs/engine_profile)
-        self.profiler = EngineStepProfiler()
+        self.profiler = EngineStepProfiler(replica=replica)
+        # SLO plane: token ledger + burn-rate monitor, registered under this
+        # replica id so MultiAsyncEngine fleets federate per-replica
+        from githubrepostorag_tpu.config import get_settings
+        from githubrepostorag_tpu.obs.ledger import TokenLedger, flops_per_token
+        from githubrepostorag_tpu.obs.slo import SLOMonitor, get_slo_plane
+
+        s = get_settings()
+        fpt = s.model_flops_per_token or (
+            flops_per_token(engine.cfg) if getattr(engine, "cfg", None) else 0.0
+        )
+        self.ledger = TokenLedger(
+            replica, flops_per_tok=fpt,
+            peak_flops=s.chip_peak_tflops * 1e12,
+            window_s=s.slo_ledger_window_s,
+        )
+        self.slo = SLOMonitor(replica)
+        get_slo_plane().register(
+            replica, ledger=self.ledger, monitor=self.slo, stats=self.stats
+        )
 
     # ------------------------------------------------------------ lifecycle
 
@@ -106,29 +128,56 @@ class AsyncEngine:
             TTFT,
         )
 
-        # engine stats are cumulative ints; export deltas to the counters
+        from githubrepostorag_tpu.metrics import TPOT
+        from githubrepostorag_tpu.obs.ledger import engine_snapshot
+
+        # engine stats are cumulative ints; export deltas to the counters.
+        # every engine-owned series is bound to this driver's replica child
+        # once, outside the hot loop (labels() does a dict lookup + lock)
         last = self._exported
+        R = self.replica
+        m_ttft = TTFT.labels(replica=R)
+        m_tokens = DECODE_TOKENS.labels(replica=R)
+        m_tpot = TPOT.labels(replica=R)
+        m_running = ENGINE_RUNNING.labels(replica=R)
+        m_waiting = ENGINE_WAITING.labels(replica=R)
+        m_prefix = PREFIX_CACHE_HITS.labels(replica=R)
+        m_sprop = SPEC_PROPOSED.labels(replica=R)
+        m_sacc = SPEC_ACCEPTED.labels(replica=R)
+        m_sprop_t = SPEC_PROPOSED_TOTAL.labels(replica=R)
+        m_sacc_t = SPEC_ACCEPTED_TOTAL.labels(replica=R)
+        m_saccept = SPEC_ACCEPTANCE.labels(replica=R)
+        m_ptok = PACKED_PREFILL_TOKENS.labels(replica=R)
+        m_ppad = PACKED_PREFILL_PADDING.labels(replica=R)
+        m_reaps = ENGINE_DEADLINE_REAPS.labels(replica=R)
+        m_kv_fault = KV_FAULT_INS.labels(replica=R)
+        m_kv_wb = KV_WRITEBACKS.labels(replica=R)
+        m_kv_dedup = KV_DEDUP_HITS.labels(replica=R)
+        m_kv_hold = KV_DEDUP_HOLDS.labels(replica=R)
+        m_kv_mig = KV_MIGRATION_SECONDS.labels(replica=R)
+        m_kv_dev = KV_TIER_DEVICE_PAGES.labels(replica=R)
+        m_kv_host = KV_TIER_HOST_PAGES.labels(replica=R)
 
         def export_counters() -> None:
             hit = getattr(self.engine._allocator, "hit_tokens", 0)
             ptok = getattr(self.engine, "packed_prefill_tokens", 0)
             ppad = getattr(self.engine, "packed_prefill_padding", 0)
-            PREFIX_CACHE_HITS.inc(hit - last["hit"])
+            m_prefix.inc(hit - last["hit"])
             d_prop = self.engine.spec_proposed - last["prop"]
             d_acc = self.engine.spec_accepted - last["acc"]
-            SPEC_PROPOSED.inc(d_prop)
-            SPEC_ACCEPTED.inc(d_acc)
-            SPEC_PROPOSED_TOTAL.inc(d_prop)
-            SPEC_ACCEPTED_TOTAL.inc(d_acc)
+            m_sprop.inc(d_prop)
+            m_sacc.inc(d_acc)
+            m_sprop_t.inc(d_prop)
+            m_sacc_t.inc(d_acc)
             for reason, n in getattr(self.engine, "spec_fallbacks", {}).items():
                 prev = last["fb"].get(reason, 0)
                 if n > prev:
-                    SPEC_FALLBACKS.labels(reason=reason).inc(n - prev)
+                    SPEC_FALLBACKS.labels(replica=R, reason=reason).inc(n - prev)
                     last["fb"][reason] = n
-            PACKED_PREFILL_TOKENS.inc(ptok - last["packed_tok"])
-            PACKED_PREFILL_PADDING.inc(ppad - last["packed_pad"])
+            m_ptok.inc(ptok - last["packed_tok"])
+            m_ppad.inc(ppad - last["packed_pad"])
             reaps = self.engine.deadline_reaps
-            ENGINE_DEADLINE_REAPS.inc(reaps - last["reaps"])
+            m_reaps.inc(reaps - last["reaps"])
             alloc = self.engine._allocator
             fi = getattr(alloc, "fault_ins", 0)
             wb = getattr(alloc, "writebacks", 0)
@@ -138,46 +187,59 @@ class AsyncEngine:
                 getattr(self.engine, "migration_seconds_total", 0.0)
                 + getattr(self.engine, "fault_in_seconds_total", 0.0)
             )
-            KV_FAULT_INS.inc(fi - last["kv_fault"])
-            KV_WRITEBACKS.inc(wb - last["kv_wb"])
-            KV_DEDUP_HITS.inc(dd - last["kv_dedup"])
-            KV_DEDUP_HOLDS.inc(hold - last["kv_hold"])
+            m_kv_fault.inc(fi - last["kv_fault"])
+            m_kv_wb.inc(wb - last["kv_wb"])
+            m_kv_dedup.inc(dd - last["kv_dedup"])
+            m_kv_hold.inc(hold - last["kv_hold"])
             if mig_s > last["kv_mig_s"]:
                 # one observation per step that migrated: this step's
                 # migration host time (the cumulative totals' delta)
-                KV_MIGRATION_SECONDS.observe(mig_s - last["kv_mig_s"])
-            KV_TIER_DEVICE_PAGES.set(alloc.free_count)
-            KV_TIER_HOST_PAGES.set(getattr(alloc, "host_pages", 0))
+                m_kv_mig.observe(mig_s - last["kv_mig_s"])
+            m_kv_dev.set(alloc.free_count)
+            m_kv_host.set(getattr(alloc, "host_pages", 0))
             last.update(hit=hit, prop=self.engine.spec_proposed,
                         acc=self.engine.spec_accepted,
                         packed_tok=ptok, packed_pad=ppad, reaps=reaps,
                         kv_fault=fi, kv_wb=wb, kv_dedup=dd, kv_hold=hold,
                         kv_mig_s=mig_s)
 
-        from githubrepostorag_tpu.metrics import TPOT
-
         while not self._stop:
             step_start = time.monotonic()
             with self._lock:
                 has_work = self.engine.has_work()
                 finished = self.engine.step() if has_work else []
-                ENGINE_RUNNING.set(self.engine.num_running)
-                ENGINE_WAITING.set(self.engine.num_waiting)
+                m_running.set(self.engine.num_running)
+                m_waiting.set(self.engine.num_waiting)
                 export_counters()
+                snap = engine_snapshot(self.engine) if has_work else None
             if has_work:
-                self.profiler.on_step(step_start, time.monotonic())
+                step_end = time.monotonic()
+                compiles = self.profiler.on_step(step_start, step_end)
+                self.ledger.on_step(snap, step_start, step_end,
+                                    compiles=compiles)
             else:
                 self.profiler.idle()
+                self.ledger.idle()
             for res in finished:
-                DECODE_TOKENS.inc(len(res.output_tokens))
+                m_tokens.inc(len(res.output_tokens))
                 if res.ttft_s is not None:
-                    TTFT.observe(res.ttft_s)
+                    m_ttft.observe(res.ttft_s)
                 decoded = len(res.output_tokens) - 1  # first token is prefill's
+                tpot = None
                 if decoded > 0 and res.decode_time_s > 0:
-                    TPOT.observe(res.decode_time_s / decoded)
+                    tpot = res.decode_time_s / decoded
+                    m_tpot.observe(tpot)
                 if res.spec_proposed > 0:
-                    SPEC_ACCEPTANCE.observe(res.spec_accepted / res.spec_proposed)
+                    m_saccept.observe(res.spec_accepted / res.spec_proposed)
+                self.slo.observe(
+                    self._priority.pop(res.request_id, None) or "interactive",
+                    ttft_s=res.ttft_s, tpot_s=tpot,
+                    deadline_missed=res.finish_reason == "deadline",
+                )
                 self._emit(res.request_id, StreamEvent(type="final", result=res))
+            # keep burn rates decaying while no requests finish (recovery
+            # back to ok must not wait for the next completion)
+            self.slo.maybe_refresh()
             if not has_work:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
@@ -196,10 +258,13 @@ class AsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
+        priority: str = "interactive",
     ) -> AsyncIterator[StreamEvent]:
         """Submit a request and yield token events then the final event.
         ``deadline_s`` (absolute time.monotonic()) lets the engine reap the
-        request at a step boundary once its caller's budget is gone."""
+        request at a step boundary once its caller's budget is gone.
+        ``priority`` is the SLO class the request's TTFT/TPOT/deadline
+        events count against (obs/slo.py)."""
         await self.start()
         q: asyncio.Queue[StreamEvent] = asyncio.Queue()
 
@@ -212,6 +277,7 @@ class AsyncEngine:
                 deadline_s=deadline_s,
             )
             self._queues[rid] = q
+            self._priority[rid] = priority
         self._wake.set()
         try:
             while True:
@@ -228,8 +294,10 @@ class AsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
+        priority: str = "interactive",
     ) -> GenerationResult:
-        async for event in self.stream(prompt_ids, sampling, request_id, deadline_s=deadline_s):
+        async for event in self.stream(prompt_ids, sampling, request_id,
+                                       deadline_s=deadline_s, priority=priority):
             if event.type == "final":
                 return event.result
         raise RuntimeError("stream ended without a final event")  # pragma: no cover

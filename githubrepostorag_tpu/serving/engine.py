@@ -422,6 +422,17 @@ class Engine:
         self.requests_admitted = 0  # cumulative add_request count
         self.deadline_reaps = 0  # requests reaped past their deadline
 
+        # SLO-plane token economics + per-phase step time (cumulative;
+        # obs/ledger.py snapshots these each driver step and differences
+        # them into rolling goodput / MFU / limiter attribution)
+        self.committed_tokens = 0  # tokens landed in request outputs
+        self.prefill_tokens = 0  # real (non-padding) prompt tokens advanced
+        self.reaped_tokens = 0  # output tokens discarded by deadline reaps
+        self.admission_blocked_steps = 0  # steps with waiters the pool couldn't admit
+        self.prefill_seconds_total = 0.0
+        self.decode_seconds_total = 0.0
+        self.spec_verify_seconds_total = 0.0
+
         # host-side batch state
         self._block_tables = np.zeros((max_num_seqs, self.max_pages_per_seq), dtype=np.int32)
         self._seq_lens = np.zeros((max_num_seqs,), dtype=np.int32)
@@ -553,7 +564,13 @@ class Engine:
         if self._kv_tier_on:
             self._migrate_pages()
 
+        t_pf = time.monotonic()
         prefilled = self._try_prefill(finished)
+        self.prefill_seconds_total += time.monotonic() - t_pf
+        if self._waiting:
+            # a request is still queued after an admission attempt: blocked
+            # on rows/pages/dedup-hold this step (ledger's hbm_pages signal)
+            self.admission_blocked_steps += 1
         running = [r for r in self._row_req.values() if r.state == "running"]
         if self.prefill_priority and prefilled and self.is_admitting:
             # prefill-priority: a chunk ran and prompts remain — give the
@@ -562,6 +579,8 @@ class Engine:
             # and decode always runs (which is also what frees pages).
             running = []
         if running:
+            t_run = time.monotonic()
+            spec_path = True  # flipped off on the plain-decode branches
             if self._draft_enabled and not self._force_plain:
                 capable = [r for r in running if self._spec_capable(r)]
                 if capable and len(capable) == len(running):
@@ -571,6 +590,7 @@ class Engine:
                     # dispatch to plain decode (the spec burst is greedy-only
                     # and batch-shaped).  Rows that were individually capable
                     # stay capable — the mix is per-step, not sticky.
+                    spec_path = False
                     self._decode_step(finished)
             elif self.spec_ngram_k > 0:
                 all_greedy = all(
@@ -583,7 +603,13 @@ class Engine:
                 else:
                     self._spec_decode_step(finished)
             else:
+                spec_path = False
                 self._decode_step(finished)
+            dt = time.monotonic() - t_run
+            if spec_path:
+                self.spec_verify_seconds_total += dt
+            else:
+                self.decode_seconds_total += dt
         if not self._row_req:
             # nothing left running: land any in-flight burst (its tokens
             # belong to already-finished rows) and recycle deferred pages
@@ -610,11 +636,15 @@ class Engine:
         for req in [r for r in self._waiting if r.cancelled]:
             self._waiting.remove(req)
             req.state = "done"
+            if req.deadline_expired:
+                self.reaped_tokens += len(req.output)
             finished.append(self._result(
                 req, "deadline" if req.deadline_expired else "cancelled"))
         for row, req in list(self._row_req.items()):
             if req.cancelled:
                 self._release(req)
+                if req.deadline_expired:
+                    self.reaped_tokens += len(req.output)
                 finished.append(self._result(
                     req, "deadline" if req.deadline_expired else "cancelled"))
 
@@ -1071,6 +1101,7 @@ class Engine:
         done_idx: list[int] = []
         for i, req in enumerate(reqs):
             req.prefill_pos += valids[i]
+            self.prefill_tokens += int(valids[i])
             req.seq_len = req.prefill_pos
             self._seq_lens[req.row] = req.seq_len
             self._register_full_pages(req)
@@ -1221,6 +1252,7 @@ class Engine:
         done_idx: list[int] = []
         for i, (req, share) in enumerate(packed):
             req.prefill_pos += share
+            self.prefill_tokens += int(share)
             req.seq_len = req.prefill_pos
             self._seq_lens[req.row] = req.seq_len
             self._register_full_pages(req)
@@ -1281,6 +1313,7 @@ class Engine:
                 k_scales=self._k_scales, v_scales=self._v_scales,
             )
         self.sp_prefills += 1
+        self.prefill_tokens += n
         req.prefill_pos = req.seq_len = n
         self._seq_lens[req.row] = n
 
@@ -1798,6 +1831,7 @@ class Engine:
         if req.first_token_t is None:
             req.first_token_t = time.monotonic()
         req.output.append(token)
+        self.committed_tokens += 1
         if req.on_token is not None:
             try:
                 req.on_token(req.request_id, token)

@@ -63,7 +63,11 @@ class MultiAsyncEngine:
     def __init__(self, engines: list[Engine]) -> None:
         if not engines:
             raise ValueError("need at least one engine")
-        self._engines = [AsyncEngine(e) for e in engines]
+        # replica ids r0..rN-1: each driver writes its own metric series
+        # and registers its own ledger/monitor with the SLO plane
+        self._engines = [
+            AsyncEngine(e, replica=f"r{i}") for i, e in enumerate(engines)
+        ]
         self._route: dict[str, AsyncEngine] = {}
         self._ids = itertools.count()
 
@@ -92,6 +96,7 @@ class MultiAsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
+        priority: str = "interactive",
     ) -> AsyncIterator[StreamEvent]:
         # engines generate per-engine "req-N" ids that would collide across
         # replicas; mint a process-unique id when the caller didn't
@@ -100,7 +105,8 @@ class MultiAsyncEngine:
         self._route[rid] = target
         try:
             async for event in target.stream(
-                prompt_ids, sampling, request_id=rid, deadline_s=deadline_s
+                prompt_ids, sampling, request_id=rid, deadline_s=deadline_s,
+                priority=priority,
             ):
                 yield event
         finally:
@@ -112,9 +118,10 @@ class MultiAsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
+        priority: str = "interactive",
     ) -> GenerationResult:
         async for event in self.stream(prompt_ids, sampling, request_id,
-                                       deadline_s=deadline_s):
+                                       deadline_s=deadline_s, priority=priority):
             if event.type == "final":
                 return event.result
         raise RuntimeError("stream ended without a final event")  # pragma: no cover
@@ -147,3 +154,10 @@ class MultiAsyncEngine:
         merged["replicas"] = len(per)
         merged["per_replica"] = per
         return merged
+
+    def fleet(self) -> dict[str, Any]:
+        """Pod-at-a-glance: per-replica ledgers + SLO states federated via
+        the process SLO plane (same payload as GET /debug/fleet)."""
+        from githubrepostorag_tpu.obs.slo import get_slo_plane
+
+        return get_slo_plane().fleet_payload()

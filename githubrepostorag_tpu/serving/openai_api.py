@@ -68,6 +68,8 @@ class OpenAIServer:
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
+        app.router.add_get("/debug/slo", self.debug_slo)
+        app.router.add_get("/debug/fleet", self.debug_fleet)
         return app
 
     async def start(self, host: str = "0.0.0.0", port: int = 8000) -> int:
@@ -93,6 +95,16 @@ class OpenAIServer:
 
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", **self.engine.stats()})
+
+    async def debug_slo(self, request: web.Request) -> web.Response:
+        from githubrepostorag_tpu.obs.slo import get_slo_plane
+
+        return web.json_response(get_slo_plane().slo_payload())
+
+    async def debug_fleet(self, request: web.Request) -> web.Response:
+        from githubrepostorag_tpu.obs.slo import get_slo_plane
+
+        return web.json_response(get_slo_plane().fleet_payload())
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response(
@@ -134,14 +146,19 @@ class OpenAIServer:
     ) -> web.StreamResponse:
         sampling = _sampling_from_request(body, self.tokenizer, self.default_max_tokens)
         rid = f"chatcmpl-{uuid.uuid4().hex}" if chat else f"cmpl-{uuid.uuid4().hex}"
+        # SLO priority class; unknown strings are just new classes (the
+        # monitor keys on them), so no validation beyond type
+        priority = str(body.get("priority") or "interactive")
         if body.get("stream"):
-            return await self._serve_stream(request, sampling, prompt_ids, rid, chat)
+            return await self._serve_stream(request, sampling, prompt_ids, rid, chat,
+                                            priority=priority)
 
         detok = StreamingDetokenizer(self.tokenizer)
         text_parts: list[str] = []
         result = None
         stopped_on_string = False
-        async for event in self.engine.stream(prompt_ids, sampling, request_id=rid):
+        async for event in self.engine.stream(prompt_ids, sampling, request_id=rid,
+                                              priority=priority):
             if event.type == "token":
                 text_parts.append(detok.push(event.token_id))
                 full = "".join(text_parts)
@@ -195,6 +212,7 @@ class OpenAIServer:
         prompt_ids: list[int],
         rid: str,
         chat: bool,
+        priority: str = "interactive",
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -213,7 +231,8 @@ class OpenAIServer:
         emitted = ""
         finish = None
         try:
-            async for event in self.engine.stream(prompt_ids, sampling, request_id=rid):
+            async for event in self.engine.stream(prompt_ids, sampling, request_id=rid,
+                                                  priority=priority):
                 if event.type == "token":
                     delta = detok.push(event.token_id)
                     emitted += delta

@@ -29,6 +29,9 @@ python -m tools.tpulint githubrepostorag_tpu tests \
 echo "== /debug/traces schema =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_traces_schema.py
 
+echo "== /debug/slo + /debug/fleet schema =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_slo_schema.py
+
 echo "== kv-tier oversubscription A/B (CPU-tiny) =="
 # tiered vs device-only pool at equal HBM budget: bench_kv_tier_pair
 # asserts >=1.5x admitted concurrency, token-identical outputs, and zero

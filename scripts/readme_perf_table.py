@@ -169,6 +169,9 @@ def build_table(records: list[dict], driver_name: str,
         ("Draft-model spec TTFT p95, plain / spec (CPU A/B)",
          ["spec_conc8_cpu_ttft_p95_ms_plain",
           "spec_conc8_cpu_ttft_p95_ms_spec"], "ms"),
+        ("Draft-model spec goodput, plain / spec (CPU A/B)",
+         ["spec_conc8_cpu_goodput_tok_s_plain",
+          "spec_conc8_cpu_goodput_tok_s_spec"], "tok/s"),
         ("KV tiering conc128 peak admitted rows, device-only / tiered (CPU A/B)",
          ["kv_tier_conc128_cpu_peak_concurrency_device",
           "kv_tier_conc128_cpu_peak_concurrency_tiered"], "rows"),
@@ -177,6 +180,9 @@ def build_table(records: list[dict], driver_name: str,
         ("KV tiering TTFT p95, device-only / tiered (CPU A/B)",
          ["kv_tier_conc128_cpu_ttft_p95_ms_device",
           "kv_tier_conc128_cpu_ttft_p95_ms_tiered"], "ms"),
+        ("KV tiering goodput, device-only / tiered (CPU A/B)",
+         ["kv_tier_conc128_cpu_goodput_tok_s_device",
+          "kv_tier_conc128_cpu_goodput_tok_s_tiered"], "tok/s"),
         ("Qwen2-MoE 16-expert decode, bs=8 (beyond-reference)",
          ["decode_tok_s_per_chip_qwen2-moe-16e_bs8"], "tok/s"),
         ("Qwen2-MoE 16-expert INT8 decode, bs=8",

@@ -49,6 +49,7 @@ def _fresh_state():
     """Reset process-wide singletons (bus hub, store, settings) per test."""
     from githubrepostorag_tpu.config import reload_settings
     from githubrepostorag_tpu.events.memory import reset_memory_hub
+    from githubrepostorag_tpu.obs.slo import reset_slo_plane
     from githubrepostorag_tpu.resilience.faults import reset_faults
     from githubrepostorag_tpu.resilience.policy import reset_breakers
     from githubrepostorag_tpu.store.factory import reset_store
@@ -58,8 +59,10 @@ def _fresh_state():
     reset_store()
     reset_faults()
     reset_breakers()
+    reset_slo_plane()
     yield
     reset_memory_hub()
     reset_store()
     reset_faults()
     reset_breakers()
+    reset_slo_plane()

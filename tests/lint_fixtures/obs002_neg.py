@@ -1,0 +1,20 @@
+"""OBS002 negative: metrics at module scope; hot paths bind .labels()."""
+from collections import Counter as Bag
+
+from prometheus_client import Counter, Gauge
+
+CALLS = Counter("rag_calls_total", "calls", ["replica"])
+DEPTH = Gauge("rag_depth", "queue depth")
+
+
+def handle_request(replica):
+    CALLS.labels(replica=replica).inc()  # child binding, not construction
+
+
+def set_depth(n):
+    DEPTH.set(n)
+
+
+def tally(items):
+    # collections.Counter is not a metric constructor
+    return Bag(items).most_common(3)

@@ -1,0 +1,20 @@
+"""OBS002 positive: prometheus metrics constructed in per-call scope."""
+import prometheus_client
+from prometheus_client import Counter, Histogram as Hist
+
+
+def handle_request(registry):
+    calls = Counter("rag_calls_total", "calls", registry=registry)  # fires
+    calls.inc()
+
+
+def engine_step(registry):
+    # aliased bare import still resolves to the prometheus constructor
+    lat = Hist("step_seconds", "step latency", registry=registry)
+    lat.observe(0.01)
+
+
+async def poll_loop(registry):
+    # module-dotted form inside an async driver loop
+    g = prometheus_client.Gauge("depth", "queue depth", registry=registry)
+    g.set(0)

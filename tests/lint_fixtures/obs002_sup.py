@@ -1,0 +1,11 @@
+"""OBS002 suppressed: pushgateway-style ephemeral registry, justified."""
+from prometheus_client import CollectorRegistry, Gauge
+
+
+def push_stage(stage, seconds):
+    registry = CollectorRegistry()
+    gauge = Gauge(  # tpulint: disable=OBS002 -- ephemeral per-push registry, discarded after push_to_gateway
+        "stage_seconds", "stage wall-clock", ["stage"], registry=registry,
+    )
+    gauge.labels(stage=stage).set(seconds)
+    return registry

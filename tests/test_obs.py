@@ -161,6 +161,78 @@ def test_recorder_caps_spans_per_trace():
     assert payload["dropped_spans"] == 2
 
 
+def test_recorder_bounded_memory_under_concurrent_writers():
+    # the bounded-memory contract must hold while threaded producers race
+    # the ring: trace count never exceeds max_traces, per-trace spans never
+    # exceed max_spans_per_trace, and every record() is accounted for as
+    # either a stored span, a dropped span, or part of an evicted trace
+    import threading
+
+    max_traces, max_spans = 8, 4
+    writers, spans_each = 6, 200
+    rec = FlightRecorder(max_traces=max_traces, max_spans_per_trace=max_spans)
+    start = threading.Barrier(writers)
+
+    def produce(widx):
+        start.wait()
+        for i in range(spans_each):
+            # writers collide on shared trace ids (cap path) and mint
+            # fresh ones (eviction path) in the same interleaving
+            tid = f"{(widx * spans_each + i) % (max_traces * 3):032x}"
+            rec.record(_finished_span("s", tid))
+
+    threads = [threading.Thread(target=produce, args=(w,)) for w in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    payload = rec.summaries_payload()
+    assert payload["trace_count"] <= max_traces
+    assert len(rec.trace_ids()) == payload["trace_count"]
+    stored = dropped_spans = 0
+    for tid in rec.trace_ids():
+        tp = rec.trace_payload(tid)
+        if tp is None:
+            continue  # evicted between the two reads
+        assert tp["span_count"] <= max_spans
+        stored += tp["span_count"]
+        dropped_spans += tp["dropped_spans"]
+    assert stored <= max_traces * max_spans
+    # no record() vanished silently: with 3*max_traces trace ids cycling,
+    # evictions and span drops must both have fired under the race
+    assert payload["dropped_traces"] > 0
+    assert dropped_spans + stored > 0
+
+
+def test_recorder_drop_counters_are_exact_single_trace_race():
+    # all writers hammer ONE trace id: no evictions possible, so stored +
+    # dropped must equal exactly the number of record() calls
+    import threading
+
+    max_spans = 16
+    writers, spans_each = 8, 100
+    rec = FlightRecorder(max_traces=2, max_spans_per_trace=max_spans)
+    tid = "cc" * 16
+    start = threading.Barrier(writers)
+
+    def produce():
+        start.wait()
+        for _ in range(spans_each):
+            rec.record(_finished_span("s", tid))
+
+    threads = [threading.Thread(target=produce) for _ in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    tp = rec.trace_payload(tid)
+    assert tp["span_count"] == max_spans
+    assert tp["dropped_spans"] == writers * spans_each - max_spans
+    assert rec.summaries_payload()["dropped_traces"] == 0
+
+
 def test_phase_summary_maps_and_sums_span_names():
     rec = FlightRecorder(max_traces=4, max_spans_per_trace=16)
     tid = "bb" * 16

@@ -38,7 +38,7 @@ FIXTURES = REPO / "tests" / "lint_fixtures"
 WPA_FIXTURES = FIXTURES / "wpa"
 SHP_FIXTURES = FIXTURES / "shp"
 RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-            "TPU007", "ASY001", "ASY002", "OBS001"]
+            "TPU007", "ASY001", "ASY002", "OBS001", "OBS002"]
 WPA_RULE_IDS = ["WPA001", "WPA002", "WPA003", "WPA004"]
 SHP_RULE_IDS = ["SHP001", "SHP002", "SHP003", "SHP004"]
 ALL_RULE_IDS = RULE_IDS + WPA_RULE_IDS + SHP_RULE_IDS
@@ -77,6 +77,15 @@ def test_negative_fixtures_are_fully_clean():
     for neg in sorted(FIXTURES.glob("*_neg.py")):
         findings = analyze_file(neg)
         assert findings == [], f"{neg.name}: {[f.rule for f in findings]}"
+
+
+def test_obs002_suppressed_fixture_is_silenced_with_justification():
+    # the pushgateway pattern (ephemeral per-push registry) is the one
+    # sanctioned in-function construction; it rides on a justified disable
+    findings = analyze_file(FIXTURES / "obs002_sup.py")
+    hits = [f for f in findings if f.rule == "OBS002"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
 
 
 def test_asy001_fires_on_blocking_sleep_in_async_retry_helper():

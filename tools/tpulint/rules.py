@@ -921,3 +921,63 @@ def check_obs001(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
                     "clocks drift and step; use time.monotonic() for "
                     "elapsed-time math",
                 )
+
+
+# --------------------------------------------------------------------------
+# OBS002 — prometheus metric constructed in per-request/per-step scope
+
+
+@register(
+    "OBS002",
+    "metric object constructed inside a function",
+    "Counter/Gauge/Histogram/Summary constructors register a collector with "
+    "the registry; calling one per request or per engine step either raises "
+    "'Duplicated timeseries' or, with a fresh name each call, grows the "
+    "registry without bound (a cardinality leak by construction). Construct "
+    "metrics once at module scope and bind .labels() children in hot paths.",
+)
+def check_obs002(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    ctors = {"Counter", "Gauge", "Histogram", "Summary"}
+    # resolve what the metric constructors are actually called in THIS file
+    # (a collections.Counter or project-local Gauge must not fire): bare
+    # names bound by `from prometheus_client import Counter [as C]` and
+    # module aliases bound by `import prometheus_client [as pc]`
+    bare: dict[str, str] = {}
+    modules: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "prometheus_client":
+            for alias in node.names:
+                if alias.name in ctors:
+                    bare[alias.asname or alias.name] = alias.name
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "prometheus_client":
+                    modules.add(alias.asname or alias.name)
+
+    def ctor_name(call: ast.Call) -> str | None:
+        name = dotted(call.func)
+        if name is None:
+            return None
+        if name in bare:
+            return bare[name]
+        if "." in name:
+            mod, base = name.rsplit(".", 1)
+            if base in ctors and mod in modules:
+                return base
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            base = ctor_name(sub)
+            if base is not None:
+                yield (
+                    sub.lineno, sub.col_offset,
+                    f"prometheus {base}() constructed inside "
+                    f"'{node.name}' — per-call metric construction is a "
+                    "registry/cardinality leak; build it at module scope "
+                    "and use .labels() here",
+                )
