@@ -360,12 +360,16 @@ def _tracing_overhead_pct(wall_s: float, n_requests: int,
 
 def _slo_overhead_pct(wall_s: float, n_steps: int, n_requests: int) -> float:
     """Estimated SLO-plane overhead as a % of the scenario wall: measured
-    per-call cost of the driver's two hot-loop obs calls — the token
+    per-call cost of the driver's three hot-loop obs calls — the token
     ledger's ``on_step`` (snapshot diff + rolling sums + gauge publish)
-    once per engine step, and the burn-rate monitor's ``observe`` (event
-    append + forced multi-window refresh) once per finished request."""
+    once per engine step, the burn-rate monitor's ``observe`` (event
+    append + forced multi-window refresh) once per finished request, and
+    the router digest publish (two frozenset builds over the allocator's
+    chain maps + lock-protected swap) once per ROUTE_DIGEST_INTERVAL_S."""
+    from githubrepostorag_tpu.config import get_settings
     from githubrepostorag_tpu.obs.ledger import SNAPSHOT_FIELDS, TokenLedger
     from githubrepostorag_tpu.obs.slo import SLOMonitor
+    from githubrepostorag_tpu.serving.routing import ReplicaDigest
 
     ledger = TokenLedger("bench-overhead", flops_per_tok=1e9,
                          peak_flops=1e12, window_s=60.0)
@@ -386,7 +390,19 @@ def _slo_overhead_pct(wall_s: float, n_steps: int, n_requests: int) -> float:
         monitor.observe(ttft_s=0.01, tpot_s=0.01, deadline_missed=False,
                         now=base + i * 1e-2)
     observe_cost = (time.monotonic() - t0) / M
-    total = step_cost * max(1, n_steps) + observe_cost * max(1, n_requests)
+    # digest publishing at a severe page population: a 2048-page resident
+    # map + 512-page host map rebuilt and swapped every interval
+    digest = ReplicaDigest("bench-overhead")
+    resident_src = {os.urandom(16): i for i in range(2048)}
+    host_src = {os.urandom(16): i for i in range(512)}
+    D = 500
+    t0 = time.monotonic()
+    for _ in range(D):
+        digest.publish(frozenset(resident_src), frozenset(host_src), 0.0)
+    digest_cost = (time.monotonic() - t0) / D
+    n_digests = wall_s / max(1e-3, get_settings().route_digest_interval_s)
+    total = (step_cost * max(1, n_steps) + observe_cost * max(1, n_requests)
+             + digest_cost * n_digests)
     return 100.0 * total / max(wall_s, 1e-9)
 
 
@@ -1042,6 +1058,181 @@ def bench_kv_tier_pair(tag: str, *, waves=(48, 48, 32), prefix_len: int = 48,
             **{p: (out[p][0], out[p][1]) for p in out}}
 
 
+def bench_routing_pair(tag: str, *, waves: int = 4, per_wave: int = 64,
+                       prefix_len: int = 48, tail_len: int = 8,
+                       gen_tokens: int = 8) -> dict:
+    """``routing_conc256``: prefix-affinity fleet routing vs least-loaded
+    vs round-robin over IDENTICAL 2-replica fleets on the SAME prefix-heavy
+    RAG schedule — 256 requests drawing 6 hot 6-page document prefixes at
+    random with fresh tails, greedy sampling, a closed-loop 8-client pool
+    (one client per fleet row, as a frontend applying backpressure).
+
+    The fleet can keep all 6 documents device-resident ONLY if each replica
+    specializes: one replica's pool holds 3 prefixes plus in-flight tails
+    (26 of 28 pages), while a replica serving all 6 (36 pages) evicts on
+    every admission.  Affinity routing scores each request's chain hashes
+    against the per-replica digests, so the document set partitions across
+    the fleet and prefills hit resident pages; least-loaded and round-robin
+    spread every document over both replicas and recompute or fault-in what
+    churned out; round-robin is the no-signal floor.
+
+    Asserts before reporting: token-identical outputs across all three
+    policies, affinity TTFT p50 at or under both fallbacks, resident
+    prefix-hit-rate materially above least-loaded's, and zero live-traffic
+    XLA compiles with digest publishing active."""
+    import asyncio
+
+    from githubrepostorag_tpu.config import reload_settings
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+    from githubrepostorag_tpu.obs.engine_profile import CompileWatchdog
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.multi_engine import MultiAsyncEngine
+    from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(13), dtype=jnp.float32)
+    geom = dict(max_num_seqs=4, num_pages=28, page_size=8, max_seq_len=64,
+                prefill_chunk=32, kv_dtype=jnp.float32, decode_burst=4,
+                prefix_caching=True, kv_tier="on", kv_host_pool_pages=12,
+                kv_migrate_burst=8)
+    rng = np.random.default_rng(37)
+    prefixes = [rng.integers(0, cfg.vocab_size, prefix_len).tolist()
+                for _ in range(6)]
+    # document choice is RANDOM per request (a deterministic interleave can
+    # align with round-robin parity and hand the no-signal policy
+    # accidental perfect affinity)
+    schedule = [[prefixes[int(rng.integers(0, 6))]
+                 + rng.integers(0, cfg.vocab_size, tail_len).tolist()
+                 for _ in range(per_wave)] for _ in range(waves)]
+    prompt_pages = sum(len(p) // 8 for w in schedule for p in w)
+    sp = SamplingParams(max_tokens=gen_tokens, temperature=0.0,
+                        stop_token_ids=())
+
+    policies = ("affinity", "least_loaded", "round_robin")
+    fleets = {pol: [Engine(params, cfg, **geom) for _ in range(2)]
+              for pol in policies}
+    for fleet in fleets.values():  # equal footing: both pay compiles up front
+        for eng in fleet:
+            eng.warmup()
+    wd = CompileWatchdog()
+    wd.resync()
+
+    # fast digests so wave 1 already routes on published residency; the
+    # steady-state default (0.25 s) is tuned for second-long request streams
+    prev_interval = os.environ.get("ROUTE_DIGEST_INTERVAL_S")
+    os.environ["ROUTE_DIGEST_INTERVAL_S"] = "0.02"
+    reload_settings()
+
+    flat = [p for wave in schedule for p in wave]
+    trials = 3  # median-p50 trial is the report: a stray scheduler hiccup
+    # in a ~2 s CPU run otherwise swings a single-trial p50 past the gates
+
+    async def run(policy: str) -> dict:
+        multi = MultiAsyncEngine(fleets[policy], policy=policy)
+        await multi.start()
+        per_trial, outputs = [], None
+        try:
+            for _ in range(trials):
+                results: list = [None] * len(flat)
+                # closed-loop client pool, one client per fleet row: a RAG
+                # frontend applies backpressure, so queues stay shallow and
+                # TTFT measures routing quality (resident prefill vs
+                # fault-in/recompute), not self-inflicted queue depth
+                todo = iter(range(len(flat)))
+
+                async def client() -> None:
+                    for i in todo:
+                        results[i] = await multi.generate(flat[i], sp)
+
+                t0 = time.monotonic()
+                await asyncio.gather(*(client() for _ in range(8)))
+                wall = time.monotonic() - t0
+                ttfts = sorted(
+                    r.timings["first_token_t"] - r.timings["submit_t"]
+                    for r in results if "first_token_t" in r.timings)
+                per_trial.append(
+                    (ttfts[len(ttfts) // 2],
+                     ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))],
+                     wall))
+                outputs = [r.output_tokens for r in results]
+            router = multi.router_stats()
+        finally:
+            await multi.stop()
+        per_trial.sort()
+        p50, p95, wall = per_trial[(len(per_trial) - 1) // 2]
+        allocs = [eng._allocator for eng in fleets[policy]]
+        fault_ins = sum(a.fault_ins for a in allocs)
+        # pages served from the DEVICE tier: cached-page claims minus the
+        # ones that had to fault in from host first
+        resident = sum(a.hit_tokens for a in allocs) // 8 - fault_ins
+        return {
+            "wall_s": wall,
+            "p50": p50,
+            "p95": p95,
+            "trial_p50s_ms": [round(t[0] * 1e3, 2) for t in per_trial],
+            "outputs": outputs,
+            "router": router,
+            "hit_rate": resident / max(1, prompt_pages * trials),
+            "fault_ins": fault_ins,
+            "writebacks": sum(a.writebacks for a in allocs),
+        }
+
+    out: dict[str, dict] = {}
+    try:
+        for pol in policies:
+            out[pol] = asyncio.run(run(pol))
+    finally:
+        if prev_interval is None:
+            os.environ.pop("ROUTE_DIGEST_INTERVAL_S", None)
+        else:
+            os.environ["ROUTE_DIGEST_INTERVAL_S"] = prev_interval
+        reload_settings()
+
+    for pol in policies:
+        r = out[pol]
+        extras = {}
+        if pol == "affinity":
+            extras = {f"decisions_{k}": v
+                      for k, v in r["router"]["decisions"].items()}
+        emit(f"{tag}_ttft_p50_ms_{pol}", r["p50"] * 1e3, "ms", None,
+             trial_p50s_ms=r["trial_p50s_ms"])
+        emit(f"{tag}_ttft_p95_ms_{pol}", r["p95"] * 1e3, "ms", None)
+        emit(f"{tag}_resident_hit_rate_{pol}", r["hit_rate"], "ratio", None,
+             **extras)
+        emit(f"{tag}_fault_ins_{pol}", r["fault_ins"], "pages", None,
+             writebacks=r["writebacks"])
+        log(f"bench[{tag}]: {pol} TTFT p50 {r['p50'] * 1e3:.1f} ms / p95 "
+            f"{r['p95'] * 1e3:.1f} ms, resident hit rate "
+            f"{r['hit_rate']:.2f}, {r['fault_ins']} fault-ins, "
+            f"{r['writebacks']} writebacks, wall {r['wall_s']:.2f}s")
+
+    # the gates: routing is a placement change, never a token change
+    for pol in ("least_loaded", "round_robin"):
+        assert out["affinity"]["outputs"] == out[pol]["outputs"], \
+            f"affinity routing changed tokens vs {pol}"
+    compiles = wd.sample()
+    assert compiles == 0, \
+        f"{compiles} live-traffic XLA compile(s) during routed serving"
+    aff, ll = out["affinity"], out["least_loaded"]
+    assert aff["p50"] <= out["round_robin"]["p50"], \
+        f"affinity TTFT p50 {aff['p50']:.4f}s worse than round_robin"
+    assert aff["p50"] <= ll["p50"], \
+        f"affinity TTFT p50 {aff['p50']:.4f}s worse than least_loaded"
+    assert aff["hit_rate"] >= ll["hit_rate"] + 0.10, \
+        (f"affinity resident hit rate {aff['hit_rate']:.2f} not materially "
+         f"above least_loaded {ll['hit_rate']:.2f}")
+    hits = aff["router"]["decisions"]["affinity_hit"]
+    assert hits > 0, "affinity policy never scored a prefix hit"
+    speedup = ll["p50"] / max(aff["p50"], 1e-9)
+    emit(f"{tag}_p50_speedup_vs_least_loaded", speedup, "x", None)
+    log(f"bench[{tag}]: affinity p50 {speedup:.2f}x vs least_loaded, "
+        f"hit rate {aff['hit_rate']:.2f} vs {ll['hit_rate']:.2f}, "
+        f"{hits} affinity hits, token-identical, 0 live compiles")
+    return {pol: {k: r[k] for k in
+                  ("p50", "p95", "hit_rate", "fault_ins", "writebacks")}
+            for pol, r in out.items()} | {"speedup": speedup, "hits": hits}
+
+
 def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
     """Ingest embedding throughput (BASELINE.md asks to measure chunks/sec):
     e5-small geometry JAX BERT, length-bucketed batches."""
@@ -1155,6 +1346,40 @@ def _run_kv_tier_cpu(artifact_dir: str) -> None:
         log(f"bench: could not write BENCH_kv_tier_cpu.json ({exc})")
 
 
+def _run_routing_cpu(artifact_dir: str) -> None:
+    """Run the fleet-routing A/B and write its committed-artifact JSON.
+    Same convention as the KV-tier artifact: the full CPU run writes next
+    to bench.py, BENCH_ONLY=routing CI reruns write under artifacts/."""
+    if not budget_allows("routing_conc256_cpu", 180):
+        return
+    before = len(_RECORDS)
+    rt = bench_routing_pair("routing_conc256_cpu")
+    recs = _RECORDS[before:]
+    try:
+        os.makedirs(artifact_dir, exist_ok=True)
+        with open(os.path.join(artifact_dir, "BENCH_routing_cpu.json"), "w") as f:
+            json.dump({
+                "scenario": ("routing_conc256 (CPU A/B; prefix-affinity "
+                             "fleet routing vs least-loaded vs round-robin)"),
+                "platform": "cpu",
+                "note": (
+                    "256 prefix-heavy RAG requests (6 hot 6-page document "
+                    "prefixes) over identical 2-replica fleets, closed-loop "
+                    "8-client pool, token-identical outputs, zero "
+                    "live-traffic XLA compiles. Affinity TTFT p50 "
+                    f"{rt['speedup']:.2f}x vs least-loaded; resident "
+                    f"prefix-hit-rate {rt['affinity']['hit_rate']:.2f} vs "
+                    f"{rt['least_loaded']['hit_rate']:.2f} (least-loaded) / "
+                    f"{rt['round_robin']['hit_rate']:.2f} (round-robin); "
+                    f"{rt['hits']} affinity hits."),
+                "records": recs,
+                "summary": {r["metric"]: r["value"] for r in recs},
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        log(f"bench: could not write BENCH_routing_cpu.json ({exc})")
+
+
 def _main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -1166,11 +1391,13 @@ def _main() -> None:
 
     only = os.environ.get("BENCH_ONLY", "")
     if only:
-        if only != "kv_tier":
-            log(f"bench: unknown BENCH_ONLY={only!r} (supported: kv_tier)")
+        runners = {"kv_tier": _run_kv_tier_cpu, "routing": _run_routing_cpu}
+        if only not in runners:
+            log(f"bench: unknown BENCH_ONLY={only!r} "
+                f"(supported: {', '.join(sorted(runners))})")
             return
-        _run_kv_tier_cpu(os.path.join(os.path.dirname(__file__) or ".",
-                                      "artifacts"))
+        runners[only](os.path.join(os.path.dirname(__file__) or ".",
+                                   "artifacts"))
         return
 
     if not on_tpu:  # CPU fallback so the script still demonstrates end to end
@@ -1243,6 +1470,7 @@ def _main() -> None:
         except OSError as exc:
             log(f"bench: could not write BENCH_spec_cpu.json ({exc})")
         _run_kv_tier_cpu(os.path.dirname(__file__) or ".")
+        _run_routing_cpu(os.path.dirname(__file__) or ".")
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------

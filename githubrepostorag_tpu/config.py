@@ -186,6 +186,17 @@ class Settings:
     # peak per-chip TFLOPs for the MFU denominator (v5e bf16 = 197)
     chip_peak_tflops: float = field(default_factory=lambda: _env_float("CHIP_PEAK_TFLOPS", 197.0))
 
+    # --- Fleet router (serving/multi_engine.py) ---
+    # auto = affinity when any replica runs a prefix-caching allocator,
+    # on = always score prefixes, off = pure weighted least-loaded
+    route_affinity: str = field(default_factory=lambda: os.getenv("ROUTE_AFFINITY", "auto"))
+    # min interval between per-replica chain-digest rebuilds on the driver
+    route_digest_interval_s: float = field(
+        default_factory=lambda: _env_float("ROUTE_DIGEST_INTERVAL_S", 0.25))
+    # shortest matchable prefix run (in pages) that counts as an affinity hit
+    route_min_prefix_pages: int = field(
+        default_factory=lambda: _env_int("ROUTE_MIN_PREFIX_PAGES", 1))
+
     # --- Worker ---
     default_namespace: str = field(default_factory=lambda: os.getenv("DEFAULT_NAMESPACE", "default"))
     metrics_port: int = field(default_factory=lambda: _env_int("METRICS_PORT", 9000))

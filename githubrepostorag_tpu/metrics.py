@@ -292,6 +292,32 @@ SLO_TRANSITIONS = Counter(
     ["replica", "objective", "klass", "state"],
     registry=REGISTRY,
 )
+ROUTER_DECISIONS = Counter(
+    "rag_router_decisions_total",
+    "Fleet router outcomes: affinity_hit / affinity_miss / "
+    "skipped_breaker_open / skipped_limiter",
+    ["decision"],
+    registry=REGISTRY,
+)
+ROUTER_PREFIX_PAGES = Counter(
+    "rag_router_prefix_pages_total",
+    "Prefix pages the router matched against the chosen replica's digest, "
+    "by tier the match came from",
+    ["replica", "tier"],
+    registry=REGISTRY,
+)
+ROUTER_ROUTED = Counter(
+    "rag_router_routed_total",
+    "Requests routed to each replica",
+    ["replica"],
+    registry=REGISTRY,
+)
+FLEET_LIFECYCLE = Gauge(
+    "rag_fleet_replica_lifecycle",
+    "Replica lifecycle: 0=active 1=draining 2=drained 3=spare",
+    ["replica"],
+    registry=REGISTRY,
+)
 MOE_ASSIGNMENTS = Counter(
     "rag_moe_expert_assignments_total",
     "MoE router token->expert assignments offered (MOE_DROP_STATS=1)",

@@ -233,6 +233,14 @@ class TokenLedger:
             g.set(1.0 if lim == limiter else 0.0)
         self._last = (goodput, mfu, limiter)
 
+    def current_limiter(self, now: float | None = None) -> str:
+        """Cheap limiter-only read for the fleet router's fallback
+        weighting (no prune, no publish — a slightly stale attribution is
+        fine at routing cadence)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._limiter_locked(now)
+
     def snapshot(self, now: float | None = None) -> dict:
         """Rolling-window view for /debug/slo + /debug/fleet payloads."""
         now = time.monotonic() if now is None else now

@@ -207,13 +207,22 @@ class SLOPlane:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._replicas: dict[str, dict] = {}
+        self._router_info = None
 
     def register(self, replica: str, *, ledger=None, monitor=None,
-                 stats=None) -> None:
+                 stats=None, digest=None) -> None:
         with self._lock:
             self._replicas[replica] = {
                 "ledger": ledger, "monitor": monitor, "stats": stats,
+                "digest": digest,
             }
+
+    def set_router_info(self, provider) -> None:
+        """Router registers a zero-arg callable returning its decision
+        counters / lifecycle map for the fleet payload (same inversion as
+        the admission hint: obs never imports serving)."""
+        with self._lock:
+            self._router_info = provider
 
     def unregister(self, replica: str) -> None:
         with self._lock:
@@ -253,6 +262,7 @@ class SLOPlane:
     def fleet_payload(self) -> dict:
         with self._lock:
             entries = sorted(self._replicas.items())
+            router_info = self._router_info
         replicas = []
         goodput = 0.0
         committed = 0
@@ -273,12 +283,20 @@ class SLOPlane:
                     stats = stats_fn() or {}
                 except Exception:  # noqa: BLE001 - debug payload must render
                     stats = {}
+            dig = e.get("digest")
             replicas.append({
                 "replica": rid,
                 "ledger": snap,
                 "slo": mon.payload() if mon is not None else None,
                 "stats": stats,
+                "digest": dig.payload() if dig is not None else None,
             })
+        router = None
+        if callable(router_info):
+            try:
+                router = router_info() or None
+            except Exception:  # noqa: BLE001 - debug payload must render
+                router = None
         return {
             "admission_hint": self.admission_hint(),
             "fleet": {
@@ -287,6 +305,7 @@ class SLOPlane:
                 "committed_tokens": committed,
                 "wasted_tokens": wasted,
             },
+            "router": router,
             "replicas": replicas,
         }
 

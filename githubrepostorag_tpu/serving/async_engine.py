@@ -19,10 +19,15 @@ from typing import Any, AsyncIterator
 
 from githubrepostorag_tpu.obs.engine_profile import EngineStepProfiler
 from githubrepostorag_tpu.serving.engine import Engine, GenerationResult
+from githubrepostorag_tpu.serving.routing import ReplicaDigest
 from githubrepostorag_tpu.serving.sampling_params import SamplingParams
 from githubrepostorag_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+# replica lifecycle states (serving/multi_engine.py drives transitions;
+# gauge encoding matches metrics.FLEET_LIFECYCLE)
+LIFECYCLE_STATES = ("active", "draining", "drained", "spare")
 
 
 @dataclass
@@ -70,8 +75,16 @@ class AsyncEngine:
             window_s=s.slo_ledger_window_s,
         )
         self.slo = SLOMonitor(replica)
+        # chain-hash digest for the fleet router: the driver publishes the
+        # allocator's resident/host populations, the router snapshots them
+        # (serving/routing.py owns the cross-domain handoff)
+        self.digest = ReplicaDigest(replica)
+        # lifecycle is event-loop state: MultiAsyncEngine transitions it and
+        # its _pick reads it, both on the loop; other threads only render it
+        self.lifecycle = "active"
         get_slo_plane().register(
-            replica, ledger=self.ledger, monitor=self.slo, stats=self.stats
+            replica, ledger=self.ledger, monitor=self.slo, stats=self.stats,
+            digest=self.digest,
         )
 
     # ------------------------------------------------------------ lifecycle
@@ -203,6 +216,11 @@ class AsyncEngine:
                         kv_fault=fi, kv_wb=wb, kv_dedup=dd, kv_hold=hold,
                         kv_mig_s=mig_s)
 
+        from githubrepostorag_tpu.config import get_settings
+
+        digest_interval = get_settings().route_digest_interval_s
+        digest_next = 0.0
+
         while not self._stop:
             step_start = time.monotonic()
             with self._lock:
@@ -212,6 +230,20 @@ class AsyncEngine:
                 m_waiting.set(self.engine.num_waiting)
                 export_counters()
                 snap = engine_snapshot(self.engine) if has_work else None
+                # rate-limited chain-digest rebuild for the fleet router —
+                # allocator maps are driver-lock state, so build here and
+                # publish the frozen view through the digest's own lock
+                now = time.monotonic()
+                if now >= digest_next:
+                    alloc = self.engine._allocator
+                    res_fn = getattr(alloc, "resident_chain_hashes", None)
+                    host_fn = getattr(alloc, "host_chain_hashes", None)
+                    if res_fn is not None or host_fn is not None:
+                        resident = res_fn() if res_fn else frozenset()
+                        host = host_fn() if host_fn else frozenset()
+                        self.digest.publish(
+                            resident, host, time.monotonic() - now)
+                    digest_next = now + digest_interval
             if has_work:
                 step_end = time.monotonic()
                 compiles = self.profiler.on_step(step_start, step_end)
@@ -259,12 +291,16 @@ class AsyncEngine:
         request_id: str | None = None,
         deadline_s: float | None = None,
         priority: str = "interactive",
+        on_admit=None,
     ) -> AsyncIterator[StreamEvent]:
         """Submit a request and yield token events then the final event.
         ``deadline_s`` (absolute time.monotonic()) lets the engine reap the
         request at a step boundary once its caller's budget is gone.
         ``priority`` is the SLO class the request's TTFT/TPOT/deadline
-        events count against (obs/slo.py)."""
+        events count against (obs/slo.py).  ``on_admit(rid)`` fires on the
+        event loop the moment the request is queued on the engine — the
+        router uses it to retire its pending-admission claim exactly when
+        the load becomes visible in num_running/num_waiting."""
         await self.start()
         q: asyncio.Queue[StreamEvent] = asyncio.Queue()
 
@@ -278,6 +314,8 @@ class AsyncEngine:
             )
             self._queues[rid] = q
             self._priority[rid] = priority
+        if on_admit is not None:
+            on_admit(rid)
         self._wake.set()
         try:
             while True:

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from githubrepostorag_tpu.models.qwen2 import Qwen2Config
+from githubrepostorag_tpu.serving.chain_hash import chain_hashes
 
 
 @dataclass
@@ -193,19 +194,10 @@ class PageAllocator:
 
 
 def page_hashes(prompt: list[int], page_size: int) -> list[bytes]:
-    """Chain hash per FULL page of the prompt: h_i = H(h_{i-1} || tokens_i).
-    Chaining makes a page's identity its full token prefix, so equal hashes
-    imply byte-identical KV content (vLLM's automatic-prefix-caching block
-    hash)."""
-    import hashlib
-
-    out: list[bytes] = []
-    prev = b""
-    for start in range(0, len(prompt) - page_size + 1, page_size):
-        chunk = np.asarray(prompt[start : start + page_size], dtype=np.int64).tobytes()
-        prev = hashlib.blake2b(prev + chunk, digest_size=16).digest()
-        out.append(prev)
-    return out
+    """Chain hash per FULL page of the prompt (see serving/chain_hash.py —
+    shared with the fleet router so both sides agree on page identity by
+    construction)."""
+    return chain_hashes(prompt, page_size)
 
 
 class PrefixCachingAllocator:
@@ -327,6 +319,17 @@ class PrefixCachingAllocator:
         self._hash_to_page[h] = page
         self._page_to_hash[page] = h
 
+    def resident_chain_hashes(self) -> frozenset[bytes]:
+        """Chain hashes served from device HBM right now (router digest).
+        Caller holds the driver lock (same discipline as every allocator
+        method)."""
+        return frozenset(self._hash_to_page)
+
+    def host_chain_hashes(self) -> frozenset[bytes]:
+        """Chain hashes recoverable by fault-in (none for the base class —
+        the tiered subclass overrides)."""
+        return frozenset()
+
 
 class TieredPageAllocator(PrefixCachingAllocator):
     """Prefix-caching allocator with a host-RAM swap tier behind the
@@ -386,6 +389,10 @@ class TieredPageAllocator(PrefixCachingAllocator):
     @property
     def host_pages(self) -> int:
         return len(self._host)
+
+    def host_chain_hashes(self) -> frozenset[bytes]:
+        """Chain hashes recoverable by fault-in from the host tier."""
+        return frozenset(self._host)
 
     @property
     def plain_free_count(self) -> int:
@@ -549,10 +556,17 @@ class TieredPageAllocator(PrefixCachingAllocator):
             else:
                 self._claims.pop(h, None)
 
-    def pending_claim_pages(self, hashes: list[bytes]) -> int:
+    def pending_claim_pages(self, hashes: list[bytes] | None = None) -> int:
         """How many pages of this prompt's shareable run are mid-prefill on
         another row right now (claimed, not yet registered).  >0 tells the
-        scheduler a one-registration wait will dedup that many pages."""
+        scheduler a one-registration wait will dedup that many pages.
+
+        With ``hashes=None``: total claimed-but-unregistered pages across
+        all chains — the in-flight prefill work the fleet router folds into
+        a replica's load snapshot (queue depth alone reads "idle" while a
+        burst of admissions is still mid-prefill)."""
+        if hashes is None:
+            return sum(self._claims.values())
         n = 0
         for h in hashes:
             if self._hash_to_page.get(h) is not None or h in self._host:

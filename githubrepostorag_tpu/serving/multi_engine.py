@@ -8,9 +8,20 @@ Small models leave chips idle under pure TP (tp is capped by the KV-head
 count — a Qwen2-0.5B with 2 KV heads can use at most tp=2 of 8 chips);
 dp groups put the rest to work on independent traffic.
 
-Routing is least-loaded (running+waiting) at admission; a request never
-migrates. KV prefix caches are per-replica, so a shared RAG prefix warms
-each group once — the same trade a multi-pod deployment makes.
+Routing is prefix-affinity first: the request's chain hashes (the same
+content-chain identity ``TieredPageAllocator`` uses — serving/chain_hash)
+are scored against each replica's published digest and the request goes to
+the replica with the longest matchable prefix run, so a shared RAG prefix
+warms ONE replica instead of every one.  With no meaningful hit the router
+falls back to least-loaded weighted by each replica's ledger limiter
+attribution (a replica limited by ``hbm_pages`` or ``swap_wait`` is a bad
+target even with a short queue) and skips replicas whose circuit breaker
+is open.  A request never migrates once routed.
+
+Replicas have a lifecycle (active | draining | drained | spare): ``drain``
+stops admission, lets in-flight work finish, and writes cached pages back
+to the host tier; ``activate`` brings a drained or warm-spare replica back
+into rotation.  ``/debug/fleet`` renders all of it.
 
 Duck-types AsyncEngine for OpenAIServer: start/stop/stream/generate/
 cancel/stats.
@@ -18,15 +29,34 @@ cancel/stats.
 
 from __future__ import annotations
 
+import asyncio
 import itertools
 from typing import Any, AsyncIterator
 
+from githubrepostorag_tpu import metrics
+from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.obs.trace import NOOP_SPAN, current_span
+from githubrepostorag_tpu.resilience.faults import InjectedFault, fire_async
+from githubrepostorag_tpu.resilience.policy import get_breaker
 from githubrepostorag_tpu.serving.async_engine import AsyncEngine, StreamEvent
+from githubrepostorag_tpu.serving.chain_hash import chain_hashes
 from githubrepostorag_tpu.serving.engine import Engine, GenerationResult
+from githubrepostorag_tpu.serving.routing import (AFFINITY_LOAD_SLACK,
+                                                  score_prefix, weighted_load)
 from githubrepostorag_tpu.serving.sampling_params import SamplingParams
 from githubrepostorag_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+_LIFECYCLE_GAUGE = {"active": 0, "draining": 1, "drained": 2, "spare": 3}
+
+
+def _span():
+    """Active flight-recorder span, or the no-op sink outside a trace."""
+    return current_span() or NOOP_SPAN
+
+DECISIONS = ("affinity_hit", "affinity_miss",
+             "skipped_breaker_open", "skipped_limiter")
 
 
 def dp_submeshes(plan, devices=None):
@@ -58,37 +88,257 @@ def dp_submeshes(plan, devices=None):
 
 
 class MultiAsyncEngine:
-    """AsyncEngine facade over dp engine replicas."""
+    """Prefix-affinity fleet router over dp engine replicas.
 
-    def __init__(self, engines: list[Engine]) -> None:
+    Every method runs on the event loop; the only cross-thread reads are
+    GIL-atomic engine counters and ``ReplicaDigest.snapshot()`` (which is
+    lock-protected on both sides).  ``policy`` pins the routing policy for
+    A/B benches ("affinity" | "least_loaded" | "round_robin"); ``spares``
+    marks the last N replicas as warm spares that admit nothing until
+    ``activate``d."""
+
+    def __init__(self, engines: list[Engine], *, spares: int = 0,
+                 policy: str | None = None) -> None:
         if not engines:
             raise ValueError("need at least one engine")
+        if spares >= len(engines):
+            raise ValueError("spares must leave at least one active replica")
         # replica ids r0..rN-1: each driver writes its own metric series
-        # and registers its own ledger/monitor with the SLO plane
+        # and registers its own ledger/monitor/digest with the SLO plane
         self._engines = [
             AsyncEngine(e, replica=f"r{i}") for i, e in enumerate(engines)
         ]
+        self._by_id = {ae.replica: ae for ae in self._engines}
         self._route: dict[str, AsyncEngine] = {}
         self._ids = itertools.count()
+        self._rr = itertools.count()  # round_robin policy cursor
+        self._policy = policy
+        # picked-but-not-yet-admitted requests per replica: incremented at
+        # _pick (before any await can interleave another pick), retired by
+        # AsyncEngine.stream's on_admit when the engine queues the request
+        self._pending: dict[str, int] = {ae.replica: 0 for ae in self._engines}
+        self._breakers = {
+            ae.replica: get_breaker(f"replica-{ae.replica}")
+            for ae in self._engines
+        }
+        self._decisions = {d: 0 for d in DECISIONS}
+        # per-replica routed / prefix-hit request counts + matched pages
+        self._routed = {ae.replica: 0 for ae in self._engines}
+        self._prefix_hits = {ae.replica: 0 for ae in self._engines}
+        self._matched_resident = {ae.replica: 0 for ae in self._engines}
+        self._matched_host = {ae.replica: 0 for ae in self._engines}
+        for ae in self._engines[len(engines) - spares:]:
+            self._set_lifecycle(ae, "spare")
+        for ae in self._engines:
+            metrics.FLEET_LIFECYCLE.labels(replica=ae.replica).set(
+                _LIFECYCLE_GAUGE[ae.lifecycle])
+        from githubrepostorag_tpu.obs.slo import get_slo_plane
+
+        get_slo_plane().set_router_info(self.router_stats)
 
     # ------------------------------------------------------------ lifecycle
 
     async def start(self) -> None:
         for eng in self._engines:
-            await eng.start()
+            if eng.lifecycle != "spare":
+                await eng.start()
 
     async def stop(self) -> None:
         for eng in self._engines:
             await eng.stop()
 
-    # ------------------------------------------------------------- serving
+    def _set_lifecycle(self, ae: AsyncEngine, state: str) -> None:
+        ae.lifecycle = state
+        metrics.FLEET_LIFECYCLE.labels(replica=ae.replica).set(
+            _LIFECYCLE_GAUGE[state])
 
-    def _pick(self) -> AsyncEngine:
-        """Least-loaded admission (running + waiting are host-side ints)."""
-        return min(
-            self._engines,
-            key=lambda ae: ae.engine.num_running + ae.engine.num_waiting,
+    def _in_flight(self, ae: AsyncEngine) -> int:
+        return (ae.engine.num_running + ae.engine.num_waiting
+                + self._pending.get(ae.replica, 0))
+
+    async def drain(self, replica: str) -> dict[str, Any]:
+        """Stop admitting on ``replica``, let in-flight requests finish,
+        then write cached pages back to the host tier so a later activate
+        (or a peer's fault-in path, once pages are cross-replica) starts
+        warm.  Resolves even if the replica dies mid-drain (chaos seam
+        ``fleet.drain``): the corpse is force-stopped and still counts as
+        drained — it admits nothing either way."""
+        ae = self._by_id[replica]
+        if ae.lifecycle == "drained":
+            return {"replica": replica, "lifecycle": "drained", "waited": 0}
+        self._set_lifecycle(ae, "draining")
+        span = _span()
+        span.add_event("fleet.drain", replica=replica)
+        waited = 0
+        try:
+            await fire_async("fleet.drain")
+            while self._in_flight(ae) > 0:
+                waited += 1
+                await asyncio.sleep(0.01)
+                await fire_async("fleet.drain")
+            # writeback runs under the driver lock off-loop: evict plans +
+            # flush_kv_migrations are allocator/engine state
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._writeback_host_tier, ae)
+        except InjectedFault as exc:
+            self._breakers[replica].record_failure()
+            span.add_event("fleet.drain.fault", replica=replica,
+                           error=str(exc))
+            await ae.stop()
+            self._set_lifecycle(ae, "drained")
+            return {"replica": replica, "lifecycle": "drained",
+                    "waited": waited, "fault": str(exc)}
+        self._set_lifecycle(ae, "drained")
+        return {"replica": replica, "lifecycle": "drained", "waited": waited}
+
+    def _writeback_host_tier(self, ae: AsyncEngine) -> None:
+        engine = ae.engine
+        with ae._lock:
+            if not getattr(engine, "_kv_tier_on", False):
+                return
+            # drain the whole LRU into the host pool (bounded by its cap),
+            # then run migration boundaries until every DMA has landed
+            engine.flush_kv_migrations()
+
+    async def activate(self, replica: str) -> dict[str, Any]:
+        """Bring a warm spare or drained replica (back) into rotation."""
+        ae = self._by_id[replica]
+        self._set_lifecycle(ae, "active")
+        await ae.start()
+        _span().add_event("fleet.activate", replica=replica)
+        return {"replica": replica, "lifecycle": "active"}
+
+    # ------------------------------------------------------------- routing
+
+    def _affinity_enabled(self) -> bool:
+        if self._policy == "affinity":
+            return True
+        if self._policy in ("least_loaded", "round_robin"):
+            return False
+        mode = get_settings().route_affinity
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        # auto: affinity iff any replica can actually serve a prefix hit
+        return any(
+            hasattr(ae.engine._allocator, "resident_chain_hashes")
+            for ae in self._engines
         )
+
+    def _pick(self, prompt_ids: list[int]) -> tuple[AsyncEngine, bool]:
+        """Choose a replica; returns (target, breaker_granted).
+
+        Ranking first, breaker second: ``allow()`` consumes the single
+        half-open probe, so it is only asked about the replica we are about
+        to use — probing every candidate would wedge the ones not chosen."""
+        cands = [ae for ae in self._engines if ae.lifecycle == "active"]
+        if not cands:
+            raise RuntimeError("no active replicas (all drained or spare)")
+
+        decision = None
+        matched = {}
+        if self._policy == "round_robin":
+            ranked = [cands[next(self._rr) % len(cands)]]
+            ranked += [ae for ae in cands if ae is not ranked[0]]
+        elif self._affinity_enabled():
+            min_pages = get_settings().route_min_prefix_pages
+            hashes_by_ps: dict[int, list[bytes]] = {}
+            scored = []
+            for ae in cands:
+                ps = ae.engine.page_size
+                if ps not in hashes_by_ps:
+                    hashes_by_ps[ps] = chain_hashes(prompt_ids, ps)
+                res, hst, score = score_prefix(
+                    hashes_by_ps[ps], *ae.digest.snapshot())
+                matched[ae.replica] = (res, hst)
+                scored.append((ae, res + hst, score))
+            hits = [t for t in scored if t[1] >= max(1, min_pages)]
+            if hits:
+                # longest weighted run wins; ties go to the lighter replica
+                ranked = [t[0] for t in sorted(
+                    hits, key=lambda t: (-t[2], self._load(t[0])))]
+                floor = min(self._load(ae) for ae in cands)
+                if self._load(ranked[0]) - floor > AFFINITY_LOAD_SLACK:
+                    # the hit replica is saturated: the queue wait behind
+                    # the whole burst costs more than the saved prefill
+                    decision = "affinity_miss"
+                    ranked = self._rank_fallback(cands)
+                else:
+                    decision = "affinity_hit"
+                    ranked += [ae for ae in cands if ae not in ranked]
+            else:
+                decision = "affinity_miss"
+                ranked = self._rank_fallback(cands)
+        else:
+            ranked = self._rank_fallback(cands)
+
+        target, granted = ranked[0], False
+        for ae in ranked:
+            if self._breakers[ae.replica].allow():
+                target, granted = ae, True
+                break
+            self._count("skipped_breaker_open")
+        # all breakers refused: fail open to the best-ranked replica — a
+        # fleet-wide outage should degrade to normal routing, not a 500
+
+        if decision is not None:
+            self._count(decision)
+        self._routed[target.replica] += 1
+        metrics.ROUTER_ROUTED.labels(replica=target.replica).inc()
+        res, hst = matched.get(target.replica, (0, 0))
+        if res + hst > 0:
+            self._prefix_hits[target.replica] += 1
+            self._matched_resident[target.replica] += res
+            self._matched_host[target.replica] += hst
+            if res:
+                metrics.ROUTER_PREFIX_PAGES.labels(
+                    replica=target.replica, tier="resident").inc(res)
+            if hst:
+                metrics.ROUTER_PREFIX_PAGES.labels(
+                    replica=target.replica, tier="host").inc(hst)
+        _span().add_event(
+            "router.pick", replica=target.replica,
+            decision=decision or self._policy or "least_loaded",
+            resident_pages=res, host_pages=hst,
+            breaker_granted=granted,
+        )
+        return target, granted
+
+    def _load(self, ae: AsyncEngine) -> float:
+        """Load snapshot in request units: queue depth, plus picks not yet
+        visible as queue depth, plus claimed-but-unregistered prefill pages
+        (normalized to sequences) so a simultaneous-admission burst doesn't
+        all land on one replica that still *looks* idle."""
+        e = ae.engine
+        load = float(e.num_running + e.num_waiting
+                     + self._pending.get(ae.replica, 0))
+        claim_fn = getattr(e._allocator, "pending_claim_pages", None)
+        if callable(claim_fn):
+            pages_per_seq = max(1, e.max_seq_len // max(1, e.page_size))
+            load += claim_fn() / pages_per_seq
+        return load
+
+    def _rank_fallback(self, cands: list[AsyncEngine]) -> list[AsyncEngine]:
+        """Least-loaded weighted by the ledger's limiter attribution."""
+        raw = min(cands, key=self._load)
+
+        def key(ae: AsyncEngine) -> float:
+            return weighted_load(self._load(ae),
+                                 ae.ledger.current_limiter())
+
+        ranked = sorted(cands, key=key)
+        if ranked[0] is not raw:
+            # the shortest queue was passed over because its limiter says
+            # admissions there stall on pages/swap, not compute
+            self._count("skipped_limiter")
+        return ranked
+
+    def _count(self, decision: str) -> None:
+        self._decisions[decision] += 1
+        metrics.ROUTER_DECISIONS.labels(decision=decision).inc()
+
+    # ------------------------------------------------------------- serving
 
     async def stream(
         self,
@@ -101,15 +351,46 @@ class MultiAsyncEngine:
         # engines generate per-engine "req-N" ids that would collide across
         # replicas; mint a process-unique id when the caller didn't
         rid = request_id or f"mreq-{next(self._ids)}"
-        target = self._pick()
+        target, granted = self._pick(prompt_ids)
         self._route[rid] = target
+        self._pending[target.replica] += 1
+        admitted = False
+
+        def on_admit(_rid: str) -> None:
+            nonlocal admitted
+            if not admitted:
+                admitted = True
+                self._pending[target.replica] -= 1
+
+        breaker = self._breakers[target.replica]
+        recorded = False
         try:
             async for event in target.stream(
                 prompt_ids, sampling, request_id=rid, deadline_s=deadline_s,
-                priority=priority,
+                priority=priority, on_admit=on_admit,
             ):
+                if event.type == "final":
+                    # settle breaker + route eagerly at the final token, not
+                    # in the finally below: generator finalization is
+                    # deferred, so cleanup there could land arbitrarily late
+                    if granted and not recorded:
+                        recorded = True
+                        breaker.record_success()
+                    self._route.pop(rid, None)
                 yield event
+        except Exception:
+            if granted and not recorded:
+                recorded = True
+                breaker.record_failure()
+            raise
         finally:
+            # abandoned/cancelled streams are caller choices, not replica
+            # faults — and a granted half-open probe MUST resolve or the
+            # breaker wedges with _probing set forever
+            if granted and not recorded:
+                breaker.record_success()
+            if not admitted:
+                on_admit(rid)
             self._route.pop(rid, None)
 
     async def generate(
@@ -130,6 +411,31 @@ class MultiAsyncEngine:
         target = self._route.get(request_id)
         if target is not None:
             await target.cancel(request_id)
+
+    # ------------------------------------------------------------ reading --
+
+    def router_stats(self) -> dict[str, Any]:
+        """Decision counters + per-replica routing view (stats(), the SLO
+        plane's fleet payload, and /debug/fleet all render this)."""
+        per = {}
+        for ae in self._engines:
+            r = ae.replica
+            routed = self._routed[r]
+            per[r] = {
+                "lifecycle": ae.lifecycle,
+                "routed": routed,
+                "prefix_hit_rate": self._prefix_hits[r] / max(1, routed),
+                "matched_resident_pages": self._matched_resident[r],
+                "matched_host_pages": self._matched_host[r],
+                "pending": self._pending[r],
+                "breaker": self._breakers[r].state,
+                "digest": ae.digest.payload(),
+            }
+        return {
+            "policy": self._policy or get_settings().route_affinity,
+            "decisions": dict(self._decisions),
+            "per_replica": per,
+        }
 
     def stats(self) -> dict[str, Any]:
         per = [eng.stats() for eng in self._engines]
@@ -153,11 +459,14 @@ class MultiAsyncEngine:
                     merged[key] = sum(nums)
         merged["replicas"] = len(per)
         merged["per_replica"] = per
+        if hasattr(self, "_decisions"):  # absent on bare merge-rule stubs
+            merged["router"] = self.router_stats()
         return merged
 
     def fleet(self) -> dict[str, Any]:
-        """Pod-at-a-glance: per-replica ledgers + SLO states federated via
-        the process SLO plane (same payload as GET /debug/fleet)."""
+        """Pod-at-a-glance: per-replica ledgers + SLO states + router
+        decisions federated via the process SLO plane (same payload as GET
+        /debug/fleet)."""
         from githubrepostorag_tpu.obs.slo import get_slo_plane
 
         return get_slo_plane().fleet_payload()

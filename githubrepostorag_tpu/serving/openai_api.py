@@ -70,6 +70,8 @@ class OpenAIServer:
         app.router.add_get("/health", self.health)
         app.router.add_get("/debug/slo", self.debug_slo)
         app.router.add_get("/debug/fleet", self.debug_fleet)
+        app.router.add_post("/debug/fleet/drain", self.fleet_drain)
+        app.router.add_post("/debug/fleet/activate", self.fleet_activate)
         return app
 
     async def start(self, host: str = "0.0.0.0", port: int = 8000) -> int:
@@ -105,6 +107,29 @@ class OpenAIServer:
         from githubrepostorag_tpu.obs.slo import get_slo_plane
 
         return web.json_response(get_slo_plane().fleet_payload())
+
+    async def _fleet_lifecycle(self, request: web.Request, verb: str) -> web.Response:
+        """Shared body for POST /debug/fleet/{drain,activate}: duck-typed on
+        the engine being a MultiAsyncEngine (single-engine servers 404)."""
+        action = getattr(self.engine, verb, None)
+        if action is None:
+            return _error_response("fleet lifecycle requires replica groups",
+                                   status=404)
+        try:
+            body = await request.json()
+            replica = body["replica"]
+        except (json.JSONDecodeError, KeyError, TypeError) as exc:
+            return _error_response(f"invalid request body: {exc}", status=400)
+        try:
+            return web.json_response(await action(replica))
+        except KeyError:
+            return _error_response(f"unknown replica {replica!r}", status=404)
+
+    async def fleet_drain(self, request: web.Request) -> web.Response:
+        return await self._fleet_lifecycle(request, "drain")
+
+    async def fleet_activate(self, request: web.Request) -> web.Response:
+        return await self._fleet_lifecycle(request, "activate")
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response(

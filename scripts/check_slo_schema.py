@@ -49,9 +49,11 @@ def shape(value):
 def build_payloads():
     """One synthetic replica exercising every field both payloads can
     emit: ledger steps touching every bucket and token outcome, SLO
-    observations against every objective (hit and miss)."""
+    observations against every objective (hit and miss), a chain digest,
+    and the fleet router's decision/per-replica view."""
     from githubrepostorag_tpu.obs.ledger import SNAPSHOT_FIELDS, TokenLedger
     from githubrepostorag_tpu.obs.slo import SLOMonitor, SLOPlane
+    from githubrepostorag_tpu.serving.routing import ReplicaDigest
 
     now = time.monotonic()
     ledger = TokenLedger("r0", flops_per_tok=1e9, peak_flops=1e12,
@@ -71,10 +73,27 @@ def build_payloads():
     monitor.observe("batch", ttft_s=99.0, tpot_s=99.0,
                     deadline_missed=True, now=now - 0.4)
 
+    digest = ReplicaDigest("r0")
+    digest.publish(frozenset([b"a"]), frozenset([b"b"]), 0.001)
+
     plane = SLOPlane()  # a private plane: no admission-hint registration
     plane.register("r0", ledger=ledger, monitor=monitor,
                    stats=lambda: {"num_running": 0, "num_waiting": 0,
-                                  "free_pages": 32})
+                                  "free_pages": 32},
+                   digest=digest)
+    # the same shape MultiAsyncEngine.router_stats() renders (the router
+    # registers it via SLOPlane.set_router_info)
+    plane.set_router_info(lambda: {
+        "policy": "auto",
+        "decisions": {"affinity_hit": 1, "affinity_miss": 1,
+                      "skipped_breaker_open": 0, "skipped_limiter": 0},
+        "per_replica": {"r0": {
+            "lifecycle": "active", "routed": 2, "prefix_hit_rate": 0.5,
+            "matched_resident_pages": 3, "matched_host_pages": 1,
+            "pending": 0, "breaker": "closed",
+            "digest": digest.payload(),
+        }},
+    })
     return plane.slo_payload(), plane.fleet_payload()
 
 

@@ -40,6 +40,14 @@ echo "== kv-tier oversubscription A/B (CPU-tiny) =="
 # BENCH_SUMMARY.json untouched; the artifact lands in artifacts/.
 BENCH_ONLY=kv_tier JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
 
+echo "== fleet-routing A/B (CPU-tiny) =="
+# prefix-affinity vs least-loaded vs round-robin over identical 2-replica
+# fleets: bench_routing_pair asserts affinity wins TTFT p50 against both
+# fallbacks, resident prefix-hit-rate materially above least-loaded,
+# token-identical outputs, and zero live-traffic XLA recompiles with
+# digest publishing active.
+BENCH_ONLY=routing JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -1,0 +1,3 @@
+"""WPA002 router negative: the same driver-writes / router-reads digest
+pattern, but both sites swap through one lock (the ReplicaDigest
+publish/snapshot discipline)."""

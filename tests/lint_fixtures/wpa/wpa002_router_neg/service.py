@@ -1,0 +1,22 @@
+import threading
+
+
+class Replica:
+    def __init__(self):
+        self.resident = frozenset()
+        self._lock = threading.Lock()
+
+    def _drive(self):
+        while True:
+            with self._lock:
+                self.resident = frozenset([b"page"])
+
+    async def pick(self, hashes):
+        with self._lock:
+            resident = self.resident
+        n = 0
+        for h in hashes:
+            if h not in resident:
+                break
+            n += 1
+        return n

@@ -1,0 +1,3 @@
+"""WPA002 router positive: per-replica digest attributes written on the
+driver thread, read by the event-loop router's pick path, no common lock —
+the exact cross-domain handoff serving/routing.py exists to make safe."""

@@ -1,0 +1,7 @@
+import threading
+
+from wpa002_router_pos.service import Replica
+
+
+def launch(rep: Replica):
+    threading.Thread(target=rep._drive, daemon=True).start()

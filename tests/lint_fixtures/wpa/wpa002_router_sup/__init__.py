@@ -1,0 +1,2 @@
+"""WPA002 router suppressed: lock-free digest swap silenced with a
+justification (single frozenset reference store, stale-tolerant reader)."""

@@ -1,0 +1,16 @@
+class Replica:
+    def __init__(self):
+        self.resident = frozenset()
+
+    def _drive(self):
+        while True:
+            # tpulint: disable=WPA002 -- GIL-atomic single-reference frozenset swap; the router tolerates one stale digest interval
+            self.resident = frozenset([b"page"])
+
+    async def pick(self, hashes):
+        n = 0
+        for h in hashes:
+            if h not in self.resident:
+                break
+            n += 1
+        return n

@@ -719,3 +719,49 @@ def test_agent_raises_deadline_exceeded_at_stage_boundary():
     agent = _agent()
     with pytest.raises(DeadlineExceeded):
         agent.run("how are jobs created?", deadline=Deadline(0.0))
+
+
+# --------------------------------------------- fleet drain under injection
+
+
+async def test_replica_death_during_drain_still_resolves(tiny_model, monkeypatch):
+    """FAULTS kills the replica mid-drain (``fleet.drain:error``): drain
+    must still resolve — corpse force-stopped, lifecycle 'drained', the
+    breaker debited — and the surviving replica keeps serving."""
+    import jax.numpy as jnp
+
+    from githubrepostorag_tpu.serving import Engine, SamplingParams
+    from githubrepostorag_tpu.serving.multi_engine import MultiAsyncEngine
+
+    params, cfg = tiny_model
+    multi = MultiAsyncEngine([
+        Engine(params, cfg, max_num_seqs=2, num_pages=32, page_size=8,
+               max_seq_len=64, kv_dtype=jnp.float32)
+        for _ in range(2)
+    ])
+    sp = SamplingParams(temperature=0.0, max_tokens=4, stop_token_ids=())
+    try:
+        ok = await multi.generate([1, 2, 3, 4], sp)
+        assert ok.finish_reason in ("length", "stop")
+
+        _enable(monkeypatch, "fleet.drain:error")
+        before = counter_value(FAULTS_INJECTED, site="fleet.drain",
+                               action="error")
+        out = await multi.drain("r0")
+        assert out["lifecycle"] == "drained"
+        assert "fault" in out and "fleet.drain" in out["fault"]
+        assert counter_value(FAULTS_INJECTED, site="fleet.drain",
+                             action="error") == before + 1
+        assert get_breaker("replica-r0").snapshot()["consecutive_failures"] >= 1
+
+        # the fleet routes around the corpse without timing out against it
+        monkeypatch.setenv("FAULTS", "")
+        reload_settings()
+        reset_faults()
+        res = await multi.generate([5, 6, 7, 8], sp)
+        assert res.finish_reason in ("length", "stop")
+        stats = multi.router_stats()["per_replica"]
+        assert stats["r0"]["lifecycle"] == "drained"
+        assert stats["r1"]["routed"] >= 1  # survivor took the traffic
+    finally:
+        await multi.stop()

@@ -1,0 +1,353 @@
+"""Prefix-affinity fleet router (serving/multi_engine.py): chain-hash
+affinity, SLO-weighted fallback, breaker integration, the pending-admission
+staleness fix, and the drain / warm-spare replica lifecycle."""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+from githubrepostorag_tpu.obs.ledger import SNAPSHOT_FIELDS
+from githubrepostorag_tpu.resilience.policy import get_breaker
+from githubrepostorag_tpu.serving import Engine, SamplingParams
+from githubrepostorag_tpu.serving.chain_hash import chain_hashes
+from githubrepostorag_tpu.serving.kv_cache import page_hashes
+from githubrepostorag_tpu.serving.multi_engine import MultiAsyncEngine
+from githubrepostorag_tpu.serving.routing import (
+    AFFINITY_LOAD_SLACK,
+    ReplicaDigest,
+    score_prefix,
+    weighted_load,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    return cfg, params
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("max_num_seqs", 2)
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    return Engine(params, cfg, kv_dtype=jnp.float32, decode_burst=8, **kw)
+
+
+def _prompts(n, seed=11):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 512, 12 + i).tolist() for i in range(n)]
+
+
+# -------------------------------------------------------------- chain hash --
+
+
+def test_chain_hash_is_the_allocator_identity():
+    """Router and allocator must agree on page identity by construction:
+    kv_cache.page_hashes IS chain_hash.chain_hashes."""
+    toks = list(range(23))
+    assert page_hashes(toks, 4) == chain_hashes(toks, 4)
+    # one hash per FULL page; the partial trailing page gets none
+    assert len(chain_hashes(toks, 4)) == 5
+    # chained, not per-page: a different prefix changes every later hash
+    other = chain_hashes([99] + toks[1:], 4)
+    assert all(a != b for a, b in zip(chain_hashes(toks, 4), other))
+
+
+def test_score_prefix_stops_at_first_unservable_page():
+    h = chain_hashes(list(range(20)), 4)  # 5 pages
+    res, hst, score = score_prefix(h, frozenset(h[:2]), frozenset(h[2:3]))
+    assert (res, hst) == (2, 1)
+    assert score == pytest.approx(2.6)
+    # page 1 missing kills the run even though pages 2-4 are resident
+    res, hst, _ = score_prefix(h, frozenset([h[0]] + h[2:]), frozenset())
+    assert (res, hst) == (1, 0)
+
+
+def test_weighted_load_penalizes_paging_limiters():
+    assert weighted_load(2.0, "none") == 2.0
+    assert weighted_load(2.0, "hbm_pages") > weighted_load(5.0, "none")
+    assert weighted_load(0.0, "swap_wait") > weighted_load(3.0, "stall")
+
+
+def test_replica_digest_snapshot_is_immutable_view():
+    d = ReplicaDigest("r0")
+    d.publish(frozenset([b"a"]), frozenset([b"b"]), 0.001)
+    res, hst = d.snapshot()
+    assert res == {b"a"} and hst == {b"b"}
+    p = d.payload()
+    assert p["resident_pages"] == 1 and p["builds"] == 1
+
+
+# ----------------------------------------------------------------- routing --
+
+
+def test_affinity_routes_to_longest_prefix_run(tiny):
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)],
+                             policy="affinity")
+    prompt = list(range(100, 124))
+    h = chain_hashes(prompt, 4)
+    # r1 holds a longer resident run than r0
+    multi._by_id["r0"].digest.publish(frozenset(h[:2]), frozenset())
+    multi._by_id["r1"].digest.publish(frozenset(h[:5]), frozenset())
+    target, granted = multi._pick(prompt)
+    assert target.replica == "r1" and granted
+    assert multi.router_stats()["decisions"]["affinity_hit"] == 1
+    # host-tier pages extend the run but weigh less than resident ones
+    multi._by_id["r0"].digest.publish(frozenset(h[:4]), frozenset(h[4:6]))
+    target, _ = multi._pick(prompt)
+    assert target.replica == "r0"  # 4 + 2*0.6 = 5.2 beats 5.0
+    per = multi.router_stats()["per_replica"]
+    assert per["r0"]["matched_resident_pages"] == 4
+    assert per["r0"]["matched_host_pages"] == 2
+    assert per["r0"]["prefix_hit_rate"] == 1.0
+
+
+def test_affinity_yields_to_load_when_hit_replica_saturated(tiny):
+    """A prefix hit is not a license to pile a whole burst onto one
+    replica: past AFFINITY_LOAD_SLACK extra requests the router falls back
+    to the weighted ranking (and counts a miss, not a hit)."""
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)],
+                             policy="affinity")
+    prompt = list(range(300, 324))
+    h = chain_hashes(prompt, 4)
+    multi._by_id["r0"].digest.publish(frozenset(h), frozenset())
+    # within the slack the hit replica keeps winning despite deeper queues
+    multi._pending["r0"] = int(AFFINITY_LOAD_SLACK)
+    target, _ = multi._pick(prompt)
+    assert target.replica == "r0"
+    assert multi.router_stats()["decisions"]["affinity_hit"] == 1
+    # one past the slack: yield to the idle peer, counted as a miss
+    multi._pending["r0"] = int(AFFINITY_LOAD_SLACK) + 1
+    target, _ = multi._pick(prompt)
+    assert target.replica == "r1"
+    d = multi.router_stats()["decisions"]
+    assert d["affinity_hit"] == 1 and d["affinity_miss"] == 1
+
+
+def test_no_prefix_hit_falls_back_and_counts_miss(tiny):
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)],
+                             policy="affinity")
+    multi._pick(list(range(200, 220)))  # empty digests everywhere
+    d = multi.router_stats()["decisions"]
+    assert d["affinity_miss"] == 1 and d["affinity_hit"] == 0
+
+
+def test_pick_staleness_burst_spreads_over_replicas(tiny):
+    """Regression (ISSUE 11 satellite): a burst of picks whose admissions
+    have not landed yet must not all target the same 'idle' replica — the
+    load snapshot counts picked-but-unadmitted requests."""
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)],
+                             policy="least_loaded")
+    picks = []
+    for p in _prompts(6):
+        target, _ = multi._pick(p)
+        # what stream() does between _pick and the engine admission
+        multi._pending[target.replica] += 1
+        picks.append(target.replica)
+    assert set(picks) == {"r0", "r1"}, picks
+    counts = {r: picks.count(r) for r in set(picks)}
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+
+def test_breaker_open_replica_is_skipped(tiny):
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)],
+                             policy="affinity")
+    prompt = list(range(300, 320))
+    h = chain_hashes(prompt, 4)
+    multi._by_id["r0"].digest.publish(frozenset(h), frozenset())
+    br0 = get_breaker("replica-r0")
+    for _ in range(br0.failure_threshold):
+        br0.record_failure()
+    assert br0.state == "open"
+    target, granted = multi._pick(prompt)
+    assert target.replica == "r1" and granted
+    assert multi.router_stats()["decisions"]["skipped_breaker_open"] == 1
+    # every breaker refusing fails open to the best-ranked replica
+    br1 = get_breaker("replica-r1")
+    for _ in range(br1.failure_threshold):
+        br1.record_failure()
+    target, granted = multi._pick(prompt)
+    assert target.replica == "r0" and not granted
+
+
+def test_limiter_weighted_fallback_skips_paging_bound_replica(tiny):
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)],
+                             policy="least_loaded")
+    # drive r0's ledger into hbm_pages attribution: most steps blocked
+    led = multi._by_id["r0"].ledger
+    snap = {f: 0.0 for f in SNAPSHOT_FIELDS}
+    import time
+    now = time.monotonic()
+    for i in range(4):
+        snap["admission_blocked_steps"] += 1
+        snap["decode_seconds_total"] += 0.01
+        led.on_step(dict(snap), now - 1.0 + i * 0.1, now - 0.95 + i * 0.1)
+    assert led.current_limiter() == "hbm_pages"
+    target, _ = multi._pick(list(range(400, 420)))
+    assert target.replica == "r1"
+    assert multi.router_stats()["decisions"]["skipped_limiter"] == 1
+
+
+async def test_routed_traffic_token_identical_with_counters(tiny):
+    """End-to-end: mixed routed traffic produces the same tokens as a
+    single engine, and the decision counters ride stats()/fleet()."""
+    cfg, params = tiny
+    prompts = _prompts(4)
+    sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+    expected = [
+        r.output_tokens for r in _engine(params, cfg).generate(prompts, sp)
+    ]
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)])
+    try:
+        results = await asyncio.gather(*(multi.generate(p, sp) for p in prompts))
+        assert [r.output_tokens for r in results] == expected
+        router = multi.stats()["router"]
+        assert set(router["decisions"]) == {
+            "affinity_hit", "affinity_miss",
+            "skipped_breaker_open", "skipped_limiter"}
+        assert sum(v["routed"] for v in router["per_replica"].values()) == 4
+        assert all(0.0 <= v["prefix_hit_rate"] <= 1.0
+                   for v in router["per_replica"].values())
+        fleet = multi.fleet()
+        assert fleet["router"]["decisions"] == router["decisions"]
+        assert all(r["digest"] is not None for r in fleet["replicas"])
+    finally:
+        await multi.stop()
+
+
+# --------------------------------------------------------------- lifecycle --
+
+
+async def test_drain_with_in_flight_completes_token_identically(tiny):
+    cfg, params = tiny
+    prompt = _prompts(1)[0]
+    sp = SamplingParams(max_tokens=16, temperature=0.0, stop_token_ids=())
+    expected = _engine(params, cfg).generate([prompt], sp)[0].output_tokens
+
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)])
+    try:
+        tokens = []
+        drain_task = None
+        final = None
+        async for event in multi.stream(prompt, sp, request_id="drain-me"):
+            if event.type == "token":
+                tokens.append(event.token_id)
+                if drain_task is None:
+                    victim = multi._route["drain-me"].replica
+                    drain_task = asyncio.create_task(multi.drain(victim))
+            else:
+                final = event.result
+        out = await drain_task
+        assert out["lifecycle"] == "drained"
+        assert final.output_tokens == expected == tokens
+        assert multi._by_id[out["replica"]].lifecycle == "drained"
+    finally:
+        await multi.stop()
+
+
+async def test_drained_replica_admits_nothing(tiny):
+    cfg, params = tiny
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)])
+    try:
+        await multi.drain("r0")
+        before = multi._by_id["r0"].engine.requests_admitted
+        await asyncio.gather(*(multi.generate(p, sp) for p in _prompts(4)))
+        assert multi._by_id["r0"].engine.requests_admitted == before
+        assert multi.router_stats()["per_replica"]["r0"]["routed"] == 0
+        assert multi.router_stats()["per_replica"]["r1"]["routed"] == 4
+    finally:
+        await multi.stop()
+
+
+async def test_drain_writes_cached_pages_back_to_host_tier(tiny):
+    cfg, params = tiny
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    multi = MultiAsyncEngine(
+        [_engine(params, cfg, kv_tier="on", kv_host_pool_pages=32)
+         for _ in range(2)])
+    try:
+        await asyncio.gather(*(multi.generate(p, sp) for p in _prompts(4)))
+        victim = max(multi._engines,
+                     key=lambda ae: ae.engine.requests_admitted).replica
+        alloc = multi._by_id[victim].engine._allocator
+        assert len(alloc._lru) > 0  # parked prefix pages to write back
+        await multi.drain(victim)
+        assert alloc.host_pages > 0
+        assert alloc.writebacks > 0
+    finally:
+        await multi.stop()
+
+
+async def test_warm_spare_activation_restores_capacity(tiny):
+    cfg, params = tiny
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)],
+                             spares=1)
+    try:
+        assert multi._by_id["r1"].lifecycle == "spare"
+        await asyncio.gather(*(multi.generate(p, sp) for p in _prompts(3)))
+        assert multi.router_stats()["per_replica"]["r1"]["routed"] == 0
+
+        await multi.drain("r0")
+        with pytest.raises(RuntimeError, match="no active replicas"):
+            await multi.generate(_prompts(1)[0], sp)
+
+        out = await multi.activate("r1")
+        assert out["lifecycle"] == "active"
+        r = await multi.generate(_prompts(1)[0], sp)
+        assert r.finish_reason in ("length", "stop")
+        assert multi.router_stats()["per_replica"]["r1"]["routed"] == 1
+    finally:
+        await multi.stop()
+
+
+async def test_fleet_lifecycle_endpoints(tiny):
+    """POST /debug/fleet/drain + /activate drive the lifecycle over HTTP
+    and /debug/fleet renders router + lifecycle state."""
+    import json
+    import urllib.request
+
+    from githubrepostorag_tpu.serving.openai_api import OpenAIServer
+    from githubrepostorag_tpu.serving.tokenizer import ByteTokenizer
+
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)])
+    server = OpenAIServer(multi, ByteTokenizer(), model_name="tiny-fleet")
+    port = await server.start(host="127.0.0.1", port=0)
+    loop = asyncio.get_running_loop()
+
+    def call(path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+            method="POST" if body is not None else "GET",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode())
+
+    out = await loop.run_in_executor(
+        None, call, "/debug/fleet/drain", {"replica": "r1"})
+    assert out == {"replica": "r1", "lifecycle": "drained", "waited": 0}
+    fleet = await loop.run_in_executor(None, call, "/debug/fleet")
+    assert fleet["router"]["per_replica"]["r1"]["lifecycle"] == "drained"
+    out = await loop.run_in_executor(
+        None, call, "/debug/fleet/activate", {"replica": "r1"})
+    assert out["lifecycle"] == "active"
+    await server.stop()

@@ -249,6 +249,31 @@ def test_wpa004_tier_suppressed_is_silenced_with_justification():
     assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
 
 
+# The fleet router reads per-replica chain digests the driver thread
+# updates every step (serving/routing.py).  These fixtures pin the exact
+# cross-domain shape: an event-loop pick path consuming a driver-written
+# digest attribute must go through a lock (or a justified atomic swap).
+
+def test_wpa002_router_digest_read_without_lock_fires():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa002_router_pos"])
+    hits = [f for f in findings if f.rule == "WPA002" and not f.suppressed]
+    assert hits, "lock-free cross-domain digest read escaped WPA002"
+    assert any("resident" in f.message for f in hits), \
+        [f.message for f in hits]
+
+
+def test_wpa002_router_locked_digest_swap_is_silent():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa002_router_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_wpa002_router_suppressed_swap_needs_justification():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa002_router_sup"])
+    hits = [f for f in findings if f.rule == "WPA002"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+
+
 def test_domain_annotation_seeds_inference(tmp_path):
     # `# tpulint: domain=event_loop` pins a sync helper to the loop even
     # with no call edge proving it — the annotation is the seed
