@@ -380,6 +380,11 @@ def _slo_overhead_pct(wall_s: float, n_steps: int, n_requests: int) -> float:
     for i in range(N):
         snap["committed_tokens"] += 8.0
         snap["decode_seconds_total"] += 1e-3
+        # a disagg replica's steady state: every step also moves the
+        # kv_transfer accounting (snapshot diff + bucket charge + the
+        # stall-minus-transfer split), so the measured on_step cost covers
+        # the transfer plane's bookkeeping too
+        snap["transfer_seconds_total"] += 2e-4
         t = base + i * 1e-3
         ledger.on_step(dict(snap), t, t + 8e-4)
     step_cost = (time.monotonic() - t0) / N
@@ -1233,6 +1238,354 @@ def bench_routing_pair(tag: str, *, waves: int = 4, per_wave: int = 64,
             for pol, r in out.items()} | {"speedup": speedup, "hits": hits}
 
 
+def bench_disagg_pair(tag: str, *, waves: int = 4, per_wave: int = 64,
+                      prefix_len: int = 48, tail_len: int = 17,
+                      prompt_len: int = 129, gen_tokens: int = 16,
+                      trials: int = 5) -> dict:
+    """``disagg_conc256``: fused vs disaggregated prefill/decode serving
+    over IDENTICAL 3-replica fleets on the SAME prefill-heavy RAG burst —
+    256 requests per pass, 75% carrying a FRESH 8-page retrieved context
+    (two-plus prefill chunks of work that pollute a fused replica's
+    decode cadence — fresh per pass, identical across modes) and 25%
+    drawing 6 hot 3-page document prefixes with fresh tails (the content
+    the wire dedups), greedy sampling.  Each of the 5 trial schedules is
+    served by BOTH fleets back to back and the tail-latency gate takes
+    the median trial pair, so shared-host background noise lands on both
+    sides of a pair instead of deciding the comparison.
+
+    The fused fleet interleaves every admission's tail prefill chunks
+    between decode bursts, so a decoding request's inter-token cadence
+    eats prefill stalls at the tail of the distribution.  The disagg
+    fleet pins admissions to one prefill replica, ships the finished
+    full-prefix pages to an affinity-chosen decode replica (content-hash
+    dedup means a prefix the decoder already holds ships nothing), and
+    the decode replicas recompute only the tail partial page — their
+    decode cadence never sees a cold prefill.
+
+    Methodology is fixed-offered-load (the DistServe comparison): a
+    closed-loop calibration pass measures the fused fleet's capacity,
+    then BOTH fleets serve the same open-loop arrival schedule at 65% of
+    it.  Raw closed-loop tok/s would just measure decode-slot count (a
+    1-prefill + 2-decode split can never out-serve 3 fused replicas at
+    saturation); what disaggregation buys is tail latency at the load a
+    fleet is actually provisioned for, so that is what the A/B holds
+    fixed and what the gates compare.  65% is the provisioning point
+    both topologies sustain: fused replicas run busy enough that
+    admissions genuinely overlap in-flight decodes (utilization much
+    lower than that and the interference the split removes never
+    happens), while the ~30% prefill share of this workload keeps the
+    2-replica decode tier under its saturation line.
+
+    Asserts before reporting: token-identical outputs across both modes,
+    zero live-traffic XLA compiles (export gathers and import fault-ins
+    ride the warmup-precompiled migrate buckets), decode TPOT p99 at or
+    under fused in the median paired trial, goodput within noise of
+    fused at the same offered load, the kv_transfer
+    accounting charged against the same <=2% budget the obs plane lives
+    under, and a tripwire on the wire seconds themselves."""
+    import asyncio
+
+    from githubrepostorag_tpu.config import reload_settings
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+    from githubrepostorag_tpu.obs.engine_profile import CompileWatchdog
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.multi_engine import MultiAsyncEngine
+    from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(13), dtype=jnp.float32)
+    # default prompt lengths sit at 1 mod page_size (129 fresh, 48+17 hot)
+    # so a handoff ships every full prompt page and the decode replica
+    # recomputes a single tail token instead of a page-sized chunk
+    pages_per_seq = (prompt_len + gen_tokens) // 16 + 2
+    num_pages = 4 * pages_per_seq + 8
+    geom = dict(max_num_seqs=4, num_pages=num_pages, page_size=16,
+                max_seq_len=16 * pages_per_seq,
+                prefill_chunk=64, kv_dtype=jnp.float32, decode_burst=4,
+                prefix_caching=True, kv_tier="on",
+                kv_host_pool_pages=4 * num_pages, kv_migrate_burst=32)
+    rng = np.random.default_rng(41)
+    prefixes = [rng.integers(0, cfg.vocab_size, prefix_len).tolist()
+                for _ in range(6)]
+
+    def build_pass(seed: int) -> tuple[list[list[int]], np.ndarray]:
+        """One pass's arrival list: mostly fresh long prompts (real
+        prefill work — a repeated prompt would be served from the prefix
+        cache and measure nothing), the rest hot-document requests.
+        Arrival offsets are Poisson (unit-rate exponential gaps, scaled
+        by the offered rate at serve time): bursty arrivals are what
+        production traffic does, and a burst is exactly when a fused
+        replica has to run prefill chunks with decodes in flight — a
+        uniformly paced schedule lets a fast fleet pipeline admissions
+        into its idle gaps and measures nothing at the tail."""
+        prng = np.random.default_rng(seed)
+        out = []
+        for _ in range(waves * per_wave):
+            if prng.random() < 0.25:
+                out.append(prefixes[int(prng.integers(0, 6))]
+                           + prng.integers(0, cfg.vocab_size,
+                                           tail_len).tolist())
+            else:
+                out.append(prng.integers(0, cfg.vocab_size,
+                                         prompt_len).tolist())
+        return out, np.cumsum(prng.exponential(1.0, size=len(out)))
+
+    # schedule 0 warms/calibrates; 1..trials are the timed passes — the
+    # SAME lists (prompts AND arrival offsets) for both modes, so outputs
+    # must match request for request
+    schedules = [build_pass(1000 + t) for t in range(trials + 1)]
+    sp = SamplingParams(max_tokens=gen_tokens, temperature=0.0,
+                        stop_token_ids=())
+
+    modes = ("fused", "disagg")
+    fleets = {m: [Engine(params, cfg, **geom) for _ in range(3)]
+              for m in modes}
+    for fleet in fleets.values():  # equal footing: both pay compiles up front
+        for eng in fleet:
+            eng.warmup()
+    wd = CompileWatchdog()
+    wd.resync()
+
+    # fast digests (cf. bench_routing_pair) so decode-side affinity and the
+    # wire's dedup-vs-ship decision see residency from wave 1 on
+    prev_env = {k: os.environ.get(k) for k in
+                ("ROUTE_DIGEST_INTERVAL_S", "DISAGG",
+                 "DISAGG_PREFILL_REPLICAS")}
+    os.environ["ROUTE_DIGEST_INTERVAL_S"] = "0.02"
+
+    async def serve_pass(multi, sched: tuple[list[list[int]], np.ndarray],
+                         offered_rps: float | None) -> tuple:
+        """One pass over a schedule: closed-loop 8 clients when
+        ``offered_rps`` is None (capacity calibration), else open-loop
+        Poisson arrivals at the offered rate."""
+        flat, offsets = sched
+        results: list = [None] * len(flat)
+        if offered_rps is None:
+            todo = iter(range(len(flat)))
+
+            async def client() -> None:
+                for i in todo:
+                    results[i] = await multi.generate(flat[i], sp)
+
+            t0 = time.monotonic()
+            await asyncio.gather(*(client() for _ in range(8)))
+        else:
+
+            async def one(i: int) -> None:
+                await asyncio.sleep(offsets[i] / offered_rps)
+                results[i] = await multi.generate(flat[i], sp)
+
+            t0 = time.monotonic()
+            await asyncio.gather(*(one(i) for i in range(len(flat))))
+        wall = time.monotonic() - t0
+        # decode cadence per request: inter-token seconds over the decode
+        # phase (first token -> done), the latency a decode replica's
+        # user actually streams at
+        tpots = sorted(r.decode_time_s / max(1, len(r.output_tokens) - 1)
+                       for r in results)
+        # goodput counts tokens delivered inside the ARRIVAL window: the
+        # post-arrival drain is a fixed-size flush whose rate reflects
+        # slot count, not whether the fleet kept up with the offered load
+        window = None
+        if offered_rps is not None:
+            span = float(offsets[-1]) / offered_rps
+            done = sum(len(r.output_tokens) for r in results
+                       if (r.timings or {}).get("done_t", wall + t0)
+                       <= t0 + span)
+            window = (done, span)
+        toks = sum(len(r.output_tokens) for r in results)
+        return (tpots, toks / wall, wall,
+                [r.output_tokens for r in results], window)
+
+    async def run_all() -> dict[str, dict]:
+        # both fleets live for the whole run so each trial schedule can be
+        # served by the two modes back to back — a background-noise window
+        # on a shared host then lands on BOTH sides of a trial pair
+        # instead of on whichever mode happened to run minutes later.
+        # Topology is fixed at construction (assign_roles reads settings
+        # once), so flipping DISAGG between the two constructions is safe.
+        multis: dict[str, MultiAsyncEngine] = {}
+        for mode in modes:
+            os.environ["DISAGG"] = "on" if mode == "disagg" else "off"
+            os.environ["DISAGG_PREFILL_REPLICAS"] = "1"
+            reload_settings()
+            multis[mode] = MultiAsyncEngine(fleets[mode])
+            await multis[mode].start()
+        out = {m: {"per_trial": [], "outputs": [], "pooled": [],
+                   "window_toks": 0, "window_s": 0.0} for m in modes}
+        try:
+            assert multis["disagg"].disagg_stats()["enabled"], \
+                "3-replica tiered fleet failed to disaggregate"
+            # warm passes (untimed for the report): closed-loop clients
+            # drive each fleet at capacity, warming the hot prefixes —
+            # and, on disagg, shipping them once so their handoffs dedup
+            warm = schedules[0]
+            await serve_pass(multis["fused"], warm, None)
+            await serve_pass(multis["disagg"], warm, None)
+            for flat in schedules[1:]:
+                # recalibrate the offered rate right before each pair: a
+                # shared host drifts on minute scales, and a stale
+                # capacity estimate overshoots the load point for both
+                # modes (the smaller decode tier saturates first, so a
+                # stale-fast calibration reads as a disagg collapse, not
+                # as noise).  The mini-pass is closed-loop on the fused
+                # fleet — its requests/s IS the capacity being offered
+                # against.
+                mini = (warm[0][:96], warm[1][:96])
+                _, _, mini_wall, _, _ = await serve_pass(multis["fused"],
+                                                         mini, None)
+                offered_rps = 0.65 * len(mini[0]) / mini_wall
+                # alternate which mode serves first so coming off the
+                # calibration pass warm (fused) or idle (disagg) is not a
+                # systematic edge for either side
+                order = modes if len(out["fused"]["per_trial"]) % 2 == 0 \
+                    else modes[::-1]
+                for mode in order:
+                    tpots, goodput, wall, toks, window = await serve_pass(
+                        multis[mode], flat, offered_rps)
+                    out[mode]["per_trial"].append(
+                        (tpots[int(0.99 * (len(tpots) - 1))],
+                         tpots[len(tpots) // 2], goodput, wall))
+                    out[mode]["pooled"].extend(tpots)
+                    out[mode]["outputs"].append(toks)
+                    out[mode]["window_toks"] += window[0]
+                    out[mode]["window_s"] += window[1]
+            for mode in modes:
+                out[mode]["disagg"] = multis[mode].router_stats()["disagg"]
+                out[mode]["transfer_s"] = sum(eng.transfer_seconds_total
+                                              for eng in fleets[mode])
+        finally:
+            for multi in multis.values():
+                await multi.stop()
+        for mode in modes:
+            # headline quantiles pool every trial's requests (5x256
+            # samples): a p99 estimated from one 256-request trial is a
+            # top-3 order statistic and mostly measures that trial's luck
+            pooled = sorted(out[mode]["pooled"])
+            ordered = sorted(out[mode]["per_trial"])
+            out[mode].update(
+                tpot_p99=pooled[int(0.99 * (len(pooled) - 1))],
+                tpot_p95=pooled[int(0.95 * (len(pooled) - 1))],
+                tpot_p50=pooled[len(pooled) // 2],
+                goodput_tok_s=out[mode]["window_toks"]
+                / max(out[mode]["window_s"], 1e-9),
+                wall_s=ordered[(len(ordered) - 1) // 2][3],
+                trial_p99s_ms=[round(t[0] * 1e3, 2) for t in ordered])
+        return out
+
+    try:
+        out = asyncio.run(run_all())
+    finally:
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        reload_settings()
+
+    for mode in modes:
+        r = out[mode]
+        emit(f"{tag}_tpot_p99_ms_{mode}", r["tpot_p99"] * 1e3, "ms", None,
+             trial_p99s_ms=r["trial_p99s_ms"],
+             tpot_p95_ms=round(r["tpot_p95"] * 1e3, 3))
+        emit(f"{tag}_tpot_p50_ms_{mode}", r["tpot_p50"] * 1e3, "ms", None)
+        emit(f"{tag}_goodput_tok_s_{mode}", r["goodput_tok_s"], "tok/s", None)
+        log(f"bench[{tag}]: {mode} TPOT p50 {r['tpot_p50'] * 1e3:.1f} ms / "
+            f"p99 {r['tpot_p99'] * 1e3:.1f} ms, goodput "
+            f"{r['goodput_tok_s']:.0f} tok/s, wall {r['wall_s']:.2f}s")
+
+    fus, dis = out["fused"], out["disagg"]
+    ds = dis["disagg"]
+    # disaggregation is a placement change, never a token change
+    assert fus["outputs"] == dis["outputs"], \
+        "disagg serving changed tokens vs fused"
+    compiles = wd.sample()
+    assert compiles == 0, \
+        f"{compiles} live-traffic XLA compile(s) during disagg serving"
+    assert ds["handoffs"] > 0, "disagg fleet never handed off"
+    assert ds["pages_deduped"] > 0, \
+        "hot prefixes never deduped on the wire (dedup seam dark)"
+    assert not ds["fallbacks"], f"handoffs fell back: {ds['fallbacks']}"
+    # the tail-latency gate is the median per-pair p99 speedup: every
+    # trial schedule was served by both fleets back to back, so each pair
+    # compares p99s measured seconds apart under the identical arrival
+    # schedule. Pooling all samples into one p99 per mode looks stronger
+    # but is fragile on a shared host — the pooled p99 is the top ~1%
+    # bucket, and a single background stall landing in one half of one
+    # pair donates that entire bucket, flipping the comparison even when
+    # the other pairs agree. The median of the paired speedups is the
+    # robust paired statistic: a majority of head-to-head trials must
+    # favor disagg, and one poisoned pair cannot move it.
+    pair_speedups = sorted(
+        f[0] / max(d[0], 1e-9)
+        for f, d in zip(fus["per_trial"], dis["per_trial"]))
+    speedup = pair_speedups[len(pair_speedups) // 2]
+    pooled_speedup = fus["tpot_p99"] / max(dis["tpot_p99"], 1e-9)
+    assert speedup >= 1.0, \
+        (f"disagg decode TPOT p99 worse than fused in the median paired "
+         f"trial ({speedup:.2f}x; pairs "
+         f"{[round(s, 2) for s in pair_speedups]}, pooled "
+         f"{pooled_speedup:.2f}x)")
+    # both fleets were offered the identical arrival schedule: tokens
+    # delivered inside the arrival window (pooled over all trials) only
+    # diverge if the disagg fleet fell behind the offered load
+    goodput_ratio = dis["goodput_tok_s"] / max(fus["goodput_tok_s"], 1e-9)
+    assert goodput_ratio >= 0.95, \
+        (f"disagg goodput regressed to {goodput_ratio:.2f}x of fused at the "
+         "same offered load (prefill tier is the bottleneck?)")
+
+    # the <=2% obs budget, with the transfer plane's ACCOUNTING charged
+    # into it: _slo_overhead_pct's on_step microbench now moves the
+    # kv_transfer snapshot field every step, so the ledger bookkeeping the
+    # handoff added rides the same gate every obs feature lives under.
+    # The wire's data movement itself is workload, not observability — it
+    # is reported as its own metric and already policed by the goodput
+    # gate above (a wire that steals enough compute to matter shows up as
+    # the disagg fleet falling behind the offered load) — with a tripwire
+    # so a regression to per-page syncs still fails loudly.
+    n_requests = len(schedules[0][0]) * (trials + 1)  # incl. calibration
+    # per request: gen/burst decode steps + prefill chunk steps + slack
+    n_steps = n_requests * (gen_tokens // geom["decode_burst"]
+                            + prompt_len // geom["prefill_chunk"] + 2)
+    served_s = dis["wall_s"] * (trials + 1)
+    slo_pct = _slo_overhead_pct(served_s, n_steps, n_requests)
+    xfer_pct = 100.0 * dis["transfer_s"] / max(served_s, 1e-9)
+    emit(f"{tag}_transfer_wire_pct", round(xfer_pct, 4), "%", None,
+         slo_overhead_pct=round(slo_pct, 4),
+         transfer_s=round(dis["transfer_s"], 4))
+    assert slo_pct <= 2.0, \
+        (f"obs + kv_transfer accounting overhead {slo_pct:.2f}% exceeds "
+         "the 2% budget (on_step transfer bookkeeping regressed?)")
+    # ~9% observed for this workload (11 shippable pages/request, batched
+    # gather+split packs, CPU-core contention with the serving replicas
+    # inflating the unloaded ~0.03 ms/page cost several-fold); a
+    # regression to per-page device syncs reads 50%+
+    assert xfer_pct <= 15.0, \
+        (f"wire seconds {xfer_pct:.2f}% of serving wall — the export pack "
+         "path regressed (per-page device syncs?)")
+
+    emit(f"{tag}_tpot_p99_speedup_vs_fused", speedup, "x", None,
+         goodput_ratio=round(goodput_ratio, 4),
+         pooled_speedup=round(pooled_speedup, 3),
+         pair_speedups=[round(s, 3) for s in pair_speedups])
+    log(f"bench[{tag}]: disagg TPOT p99 {speedup:.2f}x vs fused, goodput "
+        f"{goodput_ratio:.2f}x, {ds['handoffs']} handoffs "
+        f"({ds['pages_shipped']} pages shipped / {ds['pages_deduped']} "
+        f"deduped), transfer {xfer_pct:.2f}% of wall, token-identical, "
+        "0 live compiles")
+    return {
+        "fused": {k: fus[k] for k in ("tpot_p99", "tpot_p50",
+                                      "goodput_tok_s")},
+        "disagg": {k: dis[k] for k in ("tpot_p99", "tpot_p50",
+                                       "goodput_tok_s")},
+        "speedup": speedup, "pooled_speedup": pooled_speedup,
+        "goodput_ratio": goodput_ratio,
+        "handoffs": ds["handoffs"], "pages_shipped": ds["pages_shipped"],
+        "pages_deduped": ds["pages_deduped"],
+        "transfer_wire_pct": xfer_pct,
+    }
+
+
 def bench_embedding(*, chunks: int, seq_len: int, batch: int) -> float:
     """Ingest embedding throughput (BASELINE.md asks to measure chunks/sec):
     e5-small geometry JAX BERT, length-bucketed batches."""
@@ -1380,6 +1733,48 @@ def _run_routing_cpu(artifact_dir: str) -> None:
         log(f"bench: could not write BENCH_routing_cpu.json ({exc})")
 
 
+def _run_disagg_cpu(artifact_dir: str) -> None:
+    """Run the disaggregated-serving A/B and write its committed-artifact
+    JSON.  Same convention as the KV-tier and routing artifacts: the full
+    CPU run writes next to bench.py, BENCH_ONLY=disagg CI reruns write
+    under artifacts/."""
+    if not budget_allows("disagg_conc256_cpu", 240):
+        return
+    before = len(_RECORDS)
+    dg = bench_disagg_pair("disagg_conc256_cpu")
+    recs = _RECORDS[before:]
+    try:
+        os.makedirs(artifact_dir, exist_ok=True)
+        with open(os.path.join(artifact_dir, "BENCH_disagg_cpu.json"), "w") as f:
+            json.dump({
+                "scenario": ("disagg_conc256 (CPU A/B; disaggregated "
+                             "prefill/decode replicas + KV page handoff "
+                             "vs fused)"),
+                "platform": "cpu",
+                "note": (
+                    "256 prefill-heavy RAG requests per pass (75% fresh "
+                    "8-page retrieved contexts, 25% hot 3-page document "
+                    "prefixes with fresh tails) over identical 3-replica "
+                    "fleets (disagg: 1 prefill + 2 decode), Poisson "
+                    "open-loop arrivals at 65% of the fused fleet's "
+                    "per-pair recalibrated capacity, 5 paired "
+                    "back-to-back trials, token-identical outputs, zero "
+                    "live-traffic XLA compiles. Decode TPOT p99 "
+                    f"{dg['speedup']:.2f}x vs fused (median pair; pooled "
+                    f"{dg['pooled_speedup']:.2f}x) at "
+                    f"{dg['goodput_ratio']:.2f}x window goodput; "
+                    f"{dg['handoffs']} handoffs, {dg['pages_shipped']} "
+                    f"pages shipped / {dg['pages_deduped']} deduped, wire "
+                    f"{dg['transfer_wire_pct']:.2f}% of wall; kv_transfer "
+                    "accounting inside the 2% obs budget."),
+                "records": recs,
+                "summary": {r["metric"]: r["value"] for r in recs},
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        log(f"bench: could not write BENCH_disagg_cpu.json ({exc})")
+
+
 def _main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -1391,7 +1786,8 @@ def _main() -> None:
 
     only = os.environ.get("BENCH_ONLY", "")
     if only:
-        runners = {"kv_tier": _run_kv_tier_cpu, "routing": _run_routing_cpu}
+        runners = {"kv_tier": _run_kv_tier_cpu, "routing": _run_routing_cpu,
+                   "disagg": _run_disagg_cpu}
         if only not in runners:
             log(f"bench: unknown BENCH_ONLY={only!r} "
                 f"(supported: {', '.join(sorted(runners))})")
@@ -1471,6 +1867,7 @@ def _main() -> None:
             log(f"bench: could not write BENCH_spec_cpu.json ({exc})")
         _run_kv_tier_cpu(os.path.dirname(__file__) or ".")
         _run_routing_cpu(os.path.dirname(__file__) or ".")
+        _run_disagg_cpu(os.path.dirname(__file__) or ".")
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------

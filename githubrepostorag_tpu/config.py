@@ -197,6 +197,23 @@ class Settings:
     route_min_prefix_pages: int = field(
         default_factory=lambda: _env_int("ROUTE_MIN_PREFIX_PAGES", 1))
 
+    # --- Disaggregated prefill/decode serving (serving/disagg.py) ---
+    # "on" splits a >=2-replica tiered fleet into prefill-specialized and
+    # decode-specialized replicas with KV page handoff between them;
+    # "off" (default) runs every replica fused exactly as before.  Fleets
+    # that can't disaggregate (single replica, non-tiered allocators)
+    # stay fused regardless.
+    disagg: str = field(default_factory=lambda: os.getenv("DISAGG", "off"))
+    # how many active replicas specialize as prefill (the rest decode);
+    # clamped so at least one decode replica remains
+    disagg_prefill_replicas: int = field(
+        default_factory=lambda: _env_int("DISAGG_PREFILL_REPLICAS", 1))
+    # KV pages per transport send during a handoff (host-side chunking of
+    # the shipped payload list; device pack/unpack always rides the
+    # KV_MIGRATE_BURST gather/scatter ladder so no new shapes compile)
+    disagg_transfer_burst: int = field(
+        default_factory=lambda: _env_int("DISAGG_TRANSFER_BURST", 32))
+
     # --- Worker ---
     default_namespace: str = field(default_factory=lambda: os.getenv("DEFAULT_NAMESPACE", "default"))
     metrics_port: int = field(default_factory=lambda: _env_int("METRICS_PORT", 9000))

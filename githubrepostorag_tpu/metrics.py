@@ -257,14 +257,14 @@ LEDGER_MFU = Gauge(
 LEDGER_LIMITER = Gauge(
     "rag_engine_limiter",
     "One-hot windowed bottleneck attribution "
-    "(hbm_pages | stall | compile | swap_wait | none)",
+    "(hbm_pages | stall | compile | swap_wait | kv_transfer | none)",
     ["replica", "limiter"],
     registry=REGISTRY,
 )
 LEDGER_STEP_SECONDS = Counter(
     "rag_engine_step_seconds_total",
-    "Engine step wall time classified into phase buckets "
-    "(prefill | decode | spec_verify | kv_migration | sched_stall | compile)",
+    "Engine step wall time classified into phase buckets (prefill | decode "
+    "| spec_verify | kv_migration | kv_transfer | sched_stall | compile)",
     ["replica", "bucket"],
     registry=REGISTRY,
 )
@@ -315,6 +315,36 @@ ROUTER_ROUTED = Counter(
 FLEET_LIFECYCLE = Gauge(
     "rag_fleet_replica_lifecycle",
     "Replica lifecycle: 0=active 1=draining 2=drained 3=spare",
+    ["replica"],
+    registry=REGISTRY,
+)
+# --- Disaggregated prefill/decode serving (serving/disagg.py)
+FLEET_ROLE = Gauge(
+    "rag_fleet_replica_role",
+    "Replica serving role under disaggregation: 0=fused 1=prefill 2=decode",
+    ["replica"],
+    registry=REGISTRY,
+)
+DISAGG_HANDOFFS = Counter(
+    "rag_disagg_handoffs_total",
+    "Prefill->decode handoff attempts by outcome: shipped (KV landed on a "
+    "decode replica and the request resumed there) or fallback_<reason> "
+    "(finished fused on the prefill replica)",
+    ["outcome"],
+    registry=REGISTRY,
+)
+DISAGG_PAGES = Counter(
+    "rag_disagg_pages_total",
+    "KV pages on the handoff path: shipped (packed + transferred) or "
+    "deduped (decode replica already held the content hash — zero bytes "
+    "moved)",
+    ["kind"],
+    registry=REGISTRY,
+)
+DISAGG_TRANSFER_SECONDS = Counter(
+    "rag_disagg_transfer_seconds_total",
+    "Host wall time packing/unpacking handoff payloads per replica "
+    "(the ledger charges the same time to its kv_transfer bucket)",
     ["replica"],
     registry=REGISTRY,
 )

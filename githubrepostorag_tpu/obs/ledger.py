@@ -8,9 +8,13 @@ The ledger sits between them: each driver step it snapshots the engine's
 cumulative counters, differences them against the previous snapshot, and
 classifies the step's wall time into phase buckets:
 
-    prefill | decode | spec_verify | kv_migration | sched_stall | compile
+    prefill | decode | spec_verify | kv_migration | kv_transfer |
+    sched_stall | compile
 
 `sched_stall` is the inter-step gap (host scheduling, lock contention);
+`kv_transfer` is disaggregated-handoff pack/unpack time (the engine's
+export/import gathers run under the driver lock between steps, so the
+raw gap would misread as scheduler stall without the split);
 `compile` is the step time a fresh XLA compilation left unaccounted for by
 the measured phases.  Token deltas are classified as committed (landed in a
 request's output), spec_rejected (drafted but refused by the target model —
@@ -22,7 +26,7 @@ Over a rolling window (SLO_LEDGER_WINDOW_S) the ledger derives:
   * MFU     — (committed + prefill) tokens x flops/token
               over elapsed x peak chip FLOPs
   * limiter — windowed bottleneck attribution:
-              compile > hbm_pages > swap_wait > stall > none
+              compile > hbm_pages > swap_wait > kv_transfer > stall > none
 
 Everything is O(1) amortized per step (running sums maintained on
 append/prune), because the driver calls `on_step` inside its hot loop and
@@ -42,9 +46,10 @@ from collections import deque
 from githubrepostorag_tpu import metrics
 
 BUCKETS = ("prefill", "decode", "spec_verify", "kv_migration",
-           "sched_stall", "compile")
+           "kv_transfer", "sched_stall", "compile")
 OUTCOMES = ("committed", "spec_rejected", "deadline_reaped")
-LIMITERS = ("hbm_pages", "stall", "compile", "swap_wait", "none")
+LIMITERS = ("hbm_pages", "stall", "compile", "swap_wait", "kv_transfer",
+            "none")
 
 # max registry-publish cadence from the driver hot loop (same resolution
 # rationale as obs/slo.py's _REFRESH_S)
@@ -59,6 +64,7 @@ SNAPSHOT_FIELDS = (
     "prefill_seconds_total", "decode_seconds_total",
     "spec_verify_seconds_total",
     "migration_seconds_total", "fault_in_seconds_total",
+    "transfer_seconds_total",
 )
 
 
@@ -128,13 +134,18 @@ class TokenLedger:
                 stall = max(0.0, step_start - self._prev_end)
             self._prev_end = step_end
 
+            # handoff export/import runs under the driver lock BETWEEN
+            # steps, so its wall time arrives as inter-step gap: charge it
+            # to kv_transfer and keep only the remainder as genuine stall
+            xfer = max(0.0, d["transfer_seconds_total"])
             rec = {
                 "prefill": max(0.0, d["prefill_seconds_total"]),
                 "decode": max(0.0, d["decode_seconds_total"]),
                 "spec_verify": max(0.0, d["spec_verify_seconds_total"]),
                 "kv_migration": max(0.0, d["migration_seconds_total"]
                                     + d["fault_in_seconds_total"]),
-                "sched_stall": stall,
+                "kv_transfer": xfer,
+                "sched_stall": max(0.0, stall - xfer),
                 "compile": 0.0,
                 "committed": max(0.0, d["committed_tokens"]),
                 "prefill_tokens": max(0.0, d["prefill_tokens"]),
@@ -146,6 +157,8 @@ class TokenLedger:
                 "steps": 1.0,
             }
             if compiles > 0:
+                # kv_transfer stays out of ``measured``: it is inter-step
+                # time, never part of this step's wall
                 measured = (rec["prefill"] + rec["decode"]
                             + rec["spec_verify"] + rec["kv_migration"])
                 rec["compile"] = max(0.0, wall - measured)
@@ -206,7 +219,8 @@ class TokenLedger:
         if not steps:
             return "none"
         busy = sum(s.get(b, 0.0) for b in
-                   ("prefill", "decode", "spec_verify", "kv_migration", "compile"))
+                   ("prefill", "decode", "spec_verify", "kv_migration",
+                    "kv_transfer", "compile"))
         denom = max(1e-9, busy + s.get("sched_stall", 0.0))
         if s.get("compiles", 0.0) > 0 and s.get("compile", 0.0) / denom > 0.05:
             return "compile"
@@ -214,6 +228,8 @@ class TokenLedger:
             return "hbm_pages"
         if s.get("kv_migration", 0.0) / denom > 0.25:
             return "swap_wait"
+        if s.get("kv_transfer", 0.0) / denom > 0.25:
+            return "kv_transfer"
         if s.get("sched_stall", 0.0) / denom > 0.5:
             return "stall"
         return "none"

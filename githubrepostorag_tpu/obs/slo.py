@@ -286,6 +286,9 @@ class SLOPlane:
             dig = e.get("digest")
             replicas.append({
                 "replica": rid,
+                # serving role under disaggregation, hoisted out of stats
+                # so fleet dashboards get it even when stats fail to render
+                "role": stats.get("role", "fused"),
                 "ledger": snap,
                 "slo": mon.payload() if mon is not None else None,
                 "stats": stats,
@@ -297,10 +300,14 @@ class SLOPlane:
                 router = router_info() or None
             except Exception:  # noqa: BLE001 - debug payload must render
                 router = None
+        roles: dict[str, int] = {}
+        for r in replicas:
+            roles[r["role"]] = roles.get(r["role"], 0) + 1
         return {
             "admission_hint": self.admission_hint(),
             "fleet": {
                 "replicas": len(replicas),
+                "roles": roles,
                 "goodput_tok_s": round(goodput, 3),
                 "committed_tokens": committed,
                 "wasted_tokens": wasted,

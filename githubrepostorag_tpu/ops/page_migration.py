@@ -35,6 +35,27 @@ def migrate_buckets(burst: int) -> list[int]:
     return sorted(out)
 
 
+def split_page_payloads(bufs, n: int) -> list[tuple]:
+    """Slice a landed gather burst into per-page payload tuples.
+
+    ``bufs`` is the ``(k, v, ks, vs, dk, dv)`` buffer tuple a
+    ``gather_pages`` burst produced (host-readable; any member may be
+    None) and ``n`` the number of real pages in it.  Each payload copies
+    its ``[:, :, i]`` slice out of the burst buffer — a view would pin
+    the whole burst in host RAM for as long as any one page stays cached.
+    The tuple layout is THE page-payload wire format: writeback landing
+    (``complete_writeback``), fault-in dispatch, and the disagg
+    export/import transport all speak it, so the three paths can never
+    drift."""
+    import numpy as np
+
+    host = [None if a is None else np.asarray(a) for a in bufs]
+    return [
+        tuple(None if a is None else a[:, :, i].copy() for a in host)
+        for i in range(n)
+    ]
+
+
 @jax.jit
 def gather_pages(
     k_pages: jnp.ndarray,  # [L, n_kv, P, ps, hd]

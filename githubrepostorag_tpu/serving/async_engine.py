@@ -55,7 +55,8 @@ class AsyncEngine:
         self._exported = {"hit": 0, "prop": 0, "acc": 0,
                           "packed_tok": 0, "packed_pad": 0, "reaps": 0,
                           "fb": {}, "kv_fault": 0, "kv_wb": 0,
-                          "kv_dedup": 0, "kv_hold": 0, "kv_mig_s": 0.0}
+                          "kv_dedup": 0, "kv_hold": 0, "kv_mig_s": 0.0,
+                          "xfer_s": 0.0}
         # step profiler: scheduler-stall gauge + XLA compile watchdog,
         # sampled once per step on the driver thread (obs/engine_profile)
         self.profiler = EngineStepProfiler(replica=replica)
@@ -82,6 +83,10 @@ class AsyncEngine:
         # lifecycle is event-loop state: MultiAsyncEngine transitions it and
         # its _pick reads it, both on the loop; other threads only render it
         self.lifecycle = "active"
+        # serving role under disaggregation ("fused" | "prefill" | "decode");
+        # MultiAsyncEngine assigns it at fleet construction and it never
+        # changes while the replica is active, so reads are safe anywhere
+        self.role = "fused"
         get_slo_plane().register(
             replica, ledger=self.ledger, monitor=self.slo, stats=self.stats,
             digest=self.digest,
@@ -210,11 +215,17 @@ class AsyncEngine:
                 m_kv_mig.observe(mig_s - last["kv_mig_s"])
             m_kv_dev.set(alloc.free_count)
             m_kv_host.set(getattr(alloc, "host_pages", 0))
+            xfer_s = getattr(self.engine, "transfer_seconds_total", 0.0)
+            if xfer_s > last["xfer_s"]:
+                from githubrepostorag_tpu.metrics import DISAGG_TRANSFER_SECONDS
+
+                DISAGG_TRANSFER_SECONDS.labels(replica=R).inc(
+                    xfer_s - last["xfer_s"])
             last.update(hit=hit, prop=self.engine.spec_proposed,
                         acc=self.engine.spec_accepted,
                         packed_tok=ptok, packed_pad=ppad, reaps=reaps,
                         kv_fault=fi, kv_wb=wb, kv_dedup=dd, kv_hold=hold,
-                        kv_mig_s=mig_s)
+                        kv_mig_s=mig_s, xfer_s=xfer_s)
 
         from githubrepostorag_tpu.config import get_settings
 
@@ -345,9 +356,35 @@ class AsyncEngine:
             self.engine.cancel(request_id)
         self._wake.set()
 
+    # ------------------------------------------------- disagg KV handoff
+
+    async def export_kv_pages(self, hashes: list[bytes]) -> list[tuple[bytes, object]]:
+        """Pack the KV payloads for ``hashes`` for shipment to a peer
+        replica.  Runs off-loop (the device readback can take milliseconds)
+        while holding the driver lock so the pages can't migrate or evict
+        out from under the gather — same executor+lock pattern as
+        MultiAsyncEngine's host-tier writeback."""
+
+        def work() -> list[tuple[bytes, object]]:
+            with self._lock:
+                return self.engine.export_kv_pages(hashes)
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
+    async def import_kv_pages(self, pages: list[tuple[bytes, object]]) -> int:
+        """Admit transferred page payloads into this replica's host tier
+        (pure host-dict work, but the allocator is driver-lock state)."""
+
+        def work() -> int:
+            with self._lock:
+                return self.engine.import_kv_pages(pages)
+
+        return await asyncio.get_running_loop().run_in_executor(None, work)
+
     def stats(self) -> dict[str, Any]:
         with self._lock:
             return {
+                "role": self.role,
                 "running": self.engine.num_running,
                 "waiting": self.engine.num_waiting,
                 "requests_admitted": self.engine.requests_admitted,
@@ -372,4 +409,6 @@ class AsyncEngine:
                 "kv_writebacks": getattr(self.engine._allocator, "writebacks", 0),
                 "kv_dedup_hits": getattr(self.engine._allocator, "dedup_hits", 0),
                 "kv_dedup_holds": getattr(self.engine, "dedup_holds", 0),
+                "kv_pages_exported": getattr(self.engine, "kv_pages_exported", 0),
+                "kv_pages_imported": getattr(self.engine, "kv_pages_imported", 0),
             }

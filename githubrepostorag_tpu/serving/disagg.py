@@ -1,0 +1,128 @@
+"""Disaggregated prefill/decode serving: role assignment + KV transport.
+
+DistServe-style split without leaving the process: under ``DISAGG=on`` a
+>=2-replica tiered fleet dedicates ``DISAGG_PREFILL_REPLICAS`` replicas to
+prefill and the rest to decode.  ``MultiAsyncEngine`` routes a new request
+to a prefill replica for a 1-token pass, ships the finished full prefix
+pages to the affinity-chosen decode replica through the transport seam
+below, and resubmits the original request there — admission ``share``s the
+imported host pages and the ordinary fault-in scatters (warmed shapes)
+land them, so the decode replica recomputes only the tail partial page and
+resumes token-identically.  Any handoff failure finishes the request fused
+on the prefill replica instead; fleets that can't split (one replica,
+untiered allocators, ``DISAGG=off``) never leave fused.
+
+This module owns the two seams that make the split swappable:
+
+* ``assign_roles`` — the fleet-construction policy deciding whether the
+  split is viable and which replica serves which role.
+* ``PageTransport`` / ``InProcessTransport`` — how exported page payloads
+  reach the peer.  In-process today it's a memcpy through the importer's
+  host tier; this interface is where an ICI / DMA / RDMA transport lands
+  later without touching the router.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+from githubrepostorag_tpu import metrics
+from githubrepostorag_tpu.resilience.faults import fire_async
+from githubrepostorag_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# gauge encoding for metrics.FLEET_ROLE
+ROLE_GAUGE = {"fused": 0, "prefill": 1, "decode": 2}
+
+
+def assign_roles(engines: list, settings) -> bool:
+    """Split ``engines`` (AsyncEngines, spares included) into prefill and
+    decode roles per ``settings``; returns whether disaggregation is on.
+
+    The split only happens when it can work: ``DISAGG=on``, at least two
+    active replicas, and every active replica running the tiered allocator
+    (the handoff moves pages through the host tier; an untiered replica
+    could neither export nor import).  ``DISAGG_PREFILL_REPLICAS`` is
+    clamped so at least one decode replica always remains.  Anything else
+    leaves every replica fused — exactly yesterday's behavior.  Spares
+    stay fused until activated; an activated spare decodes (prefill
+    capacity is the scarce, deliberate resource here)."""
+    active = [ae for ae in engines if ae.lifecycle == "active"]
+    for ae in engines:
+        ae.role = "fused"
+    on = False
+    if settings.disagg == "on":
+        tiered = all(
+            getattr(ae.engine, "_kv_tier_on", False) for ae in active
+        )
+        if len(active) >= 2 and tiered:
+            n_pre = max(1, min(settings.disagg_prefill_replicas,
+                               len(active) - 1))
+            for ae in active[:n_pre]:
+                ae.role = "prefill"
+            for ae in active[n_pre:]:
+                ae.role = "decode"
+            on = True
+            logger.info(
+                "disagg on: %d prefill / %d decode replicas",
+                n_pre, len(active) - n_pre,
+            )
+        else:
+            logger.warning(
+                "DISAGG=on but fleet can't split (%d active, tiered=%s): "
+                "staying fused", len(active), tiered,
+            )
+    for ae in engines:
+        metrics.FLEET_ROLE.labels(replica=ae.replica).set(
+            ROLE_GAUGE[ae.role])
+    return on
+
+
+class PageTransport(Protocol):
+    """Moves exported KV page payloads from one replica to another.
+
+    ``transfer`` returns ``(exported, stored)``: how many payloads left
+    the source and how many the destination actually kept (the gap is
+    pages the destination already held — content-hash dedup on the wire).
+    """
+
+    async def transfer(self, src, dst,
+                       hashes: list[bytes]) -> tuple[int, int]: ...
+
+
+class InProcessTransport:
+    """Same-process transport: export under the source driver lock, import
+    under the destination driver lock, nothing but host memcpys between.
+
+    Payloads move in chunks of ``DISAGG_TRANSFER_BURST`` pages so one huge
+    handoff can't hold either driver lock for its full duration — decode
+    steps interleave between chunks.  Each chunk crosses the
+    ``disagg.transfer`` chaos seam first, which is where a real wire
+    transport would fail too (peer died, link down), so the router's
+    fused fallback is exercised by FAULTS exactly where production breaks.
+    """
+
+    def __init__(self, burst: int) -> None:
+        self.burst = max(1, burst)
+        self.transfers = 0
+        self.chunks = 0
+
+    async def transfer(self, src, dst,
+                       hashes: list[bytes]) -> tuple[int, int]:
+        if not hashes:
+            return 0, 0
+        exported = stored = 0
+        for i in range(0, len(hashes), self.burst):
+            chunk = hashes[i:i + self.burst]
+            await fire_async("disagg.transfer")
+            pages = await src.export_kv_pages(chunk)
+            exported += len(pages)
+            stored += await dst.import_kv_pages(pages)
+            self.chunks += 1
+        self.transfers += 1
+        return exported, stored
+
+    def payload(self) -> dict[str, Any]:
+        return {"kind": "in_process", "burst": self.burst,
+                "transfers": self.transfers, "chunks": self.chunks}

@@ -44,6 +44,7 @@ from githubrepostorag_tpu.ops.page_migration import (
     gather_pages,
     migrate_buckets,
     scatter_pages,
+    split_page_payloads,
 )
 from githubrepostorag_tpu.serving.kv_cache import (
     OutOfPages,
@@ -345,6 +346,10 @@ class Engine:
         self.dedup_holds = 0  # stats: admissions held for a pending twin
         self.migration_seconds_total = 0.0  # writeback plan/dispatch/land
         self.fault_in_seconds_total = 0.0  # fault-in stage/dispatch
+        # disagg handoff economics (serving/disagg.py drives these)
+        self.kv_pages_exported = 0  # pages packed for a peer replica
+        self.kv_pages_imported = 0  # transferred pages admitted host-side
+        self.transfer_seconds_total = 0.0  # export pack + import unpack
         self.sp_prefill_threshold = sp_prefill_threshold
         self._sp = mesh.shape.get("sp", 1) if mesh is not None else 1
         self.sp_prefills = 0  # stats: prompts served by the ring-prefill path
@@ -669,14 +674,9 @@ class Engine:
         moved = False
         alloc = self._allocator
         for bufs, hashes in self._wb_pending:
-            host = [None if a is None else np.asarray(a) for a in bufs]
-            for i, h in enumerate(hashes):
-                # copy the slice: a view would pin the whole burst buffer
-                # in host RAM for as long as any one page stays cached
-                alloc.complete_writeback(
-                    h,
-                    tuple(None if a is None else a[:, :, i].copy() for a in host),
-                )
+            payloads = split_page_payloads(bufs, len(hashes))
+            for h, payload in zip(hashes, payloads):
+                alloc.complete_writeback(h, payload)
             moved = True
         self._wb_pending.clear()
         plan = alloc.evict(self.kv_migrate_burst)
@@ -775,6 +775,77 @@ class Engine:
             return
         while self._migrate_pages():
             pass
+
+    # -------------------------------------------- disagg export / import --
+
+    def export_kv_pages(self, hashes: list[bytes]) -> list[tuple[bytes, object]]:
+        """Pack the KV payloads for ``hashes`` for shipment to a peer
+        replica (disaggregated prefill->decode handoff; caller holds the
+        driver lock).  Host-tier copies serve directly; device-resident
+        pages gather through the SAME power-of-two migration-burst ladder
+        warmup precompiled, so an export can never mint a live XLA
+        program.  Hashes in neither tier are silently skipped — the
+        importer recomputes that tail, token-identically.
+
+        Unlike ``_migrate_pages`` this reads the gathers back synchronously
+        (the payload leaves this replica now); that device sync is the
+        price of the handoff and is charged to ``transfer_seconds_total``
+        (the ledger's ``kv_transfer`` bucket), never to a decode replica's
+        step loop."""
+        if not self._kv_tier_on or not hashes:
+            return []
+        t0 = time.monotonic()
+        alloc = self._allocator
+        out: list[tuple[bytes, object]] = []
+        to_gather: list[tuple[bytes, int]] = []
+        for h in hashes:
+            payload = alloc.host_payload(h)
+            if payload is not None:
+                out.append((h, payload))
+                continue
+            page = alloc.device_page_of(h)
+            if page is not None:
+                to_gather.append((h, page))
+        while to_gather:
+            burst = to_gather[: self.kv_migrate_burst]
+            to_gather = to_gather[self.kv_migrate_burst:]
+            nb = _bucket(len(burst), self.kv_migrate_burst, minimum=1)
+            idx_np = np.full((nb,), -1, dtype=np.int32)
+            idx_np[: len(burst)] = [p for _, p in burst]
+            idx = jnp.asarray(idx_np)
+            k, v, ks, vs = gather_pages(
+                self._k_pages, self._v_pages, idx, self._k_scales, self._v_scales
+            )
+            dk = dv = None
+            if self._draft_enabled:
+                # ship the draft pools too: the decode replica's draft KV
+                # must cover the prompt or speculation there would propose
+                # from uninitialized pages (see _migrate_pages)
+                dk, dv, _, _ = gather_pages(self._dk_pages, self._dv_pages, idx)
+            payloads = split_page_payloads((k, v, ks, vs, dk, dv), len(burst))
+            out.extend((h, p) for (h, _), p in zip(burst, payloads))
+        self.kv_pages_exported += len(out)
+        self.transfer_seconds_total += time.monotonic() - t0
+        return out
+
+    def import_kv_pages(self, pages: list[tuple[bytes, object]]) -> int:
+        """Admit transferred page payloads into the host tier (decode-side
+        half of the handoff; caller holds the driver lock).  Pure host-dict
+        work — the device is untouched until an admission ``share``s the
+        hash and the ordinary fault-in scatter (warmed shapes) lands it.
+        A hash this replica already serves from either tier is dropped by
+        the allocator, so a prefix it holds content-hash-deduped costs
+        nothing.  Returns how many payloads were stored."""
+        if not self._kv_tier_on or not pages:
+            return 0
+        t0 = time.monotonic()
+        alloc = self._allocator
+        stored = 0
+        for h, payload in pages:
+            stored += bool(alloc.import_page(h, payload))
+        self.kv_pages_imported += stored
+        self.transfer_seconds_total += time.monotonic() - t0
+        return stored
 
     def _register_full_pages(self, req: _Request) -> None:
         """Publish every prompt page prefill has completed so far: its KV is

@@ -385,6 +385,8 @@ class TieredPageAllocator(PrefixCachingAllocator):
         self.dedup_hits = 0  # share() hits on pages other requests hold
         self.host_evictions = 0  # host-LRU payloads dropped at capacity
         self.tier_drops = 0  # device evictions that cost nothing (saved)
+        self.page_imports = 0  # disagg handoff pages admitted (import_page)
+        self.import_dedup_skips = 0  # imports skipped: hash already servable
 
     @property
     def host_pages(self) -> int:
@@ -540,6 +542,40 @@ class TieredPageAllocator(PrefixCachingAllocator):
         the faulted content is visible — no host sync needed)."""
         staged, self._staged_faults = self._staged_faults, []
         return staged
+
+    # ------------------------------------------- disagg export / import --
+
+    def host_payload(self, h: bytes) -> object | None:
+        """Read a host-tier payload for the disagg export path WITHOUT
+        refreshing LRU recency (an export is a read by a peer replica, not
+        local reuse — it must not keep cold pages pinned here)."""
+        return self._host.get(h)
+
+    def device_page_of(self, h: bytes) -> int | None:
+        """Device page currently registered under ``h``, if any (export
+        falls back to a device gather when the host tier lacks the page)."""
+        return self._hash_to_page.get(h)
+
+    def import_page(self, h: bytes, payload: object) -> bool:
+        """Admit a transferred page payload into the host tier (the disagg
+        handoff import primitive).  Content addressing makes this
+        unconditionally safe — the payload IS what every holder of ``h``
+        expects — but a hash already servable from either tier is skipped
+        so a redundant ship can't churn the host LRU.  Returns True when
+        the payload was stored.  The imported page becomes claimable by
+        the very next admission through the ordinary ``share`` fault-in
+        machinery; nothing touches the device."""
+        if h in self._hash_to_page or h in self._host:
+            self.import_dedup_skips += 1
+            return False
+        self._host[h] = payload
+        self.page_imports += 1
+        if self.host_pool_pages > 0:
+            while len(self._host) > self.host_pool_pages:
+                cold = next(iter(self._host))
+                del self._host[cold]
+                self.host_evictions += 1
+        return True
 
     # ------------------------------------------------------ pending claims --
 

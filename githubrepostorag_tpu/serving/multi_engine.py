@@ -16,7 +16,12 @@ warms ONE replica instead of every one.  With no meaningful hit the router
 falls back to least-loaded weighted by each replica's ledger limiter
 attribution (a replica limited by ``hbm_pages`` or ``swap_wait`` is a bad
 target even with a short queue) and skips replicas whose circuit breaker
-is open.  A request never migrates once routed.
+is open.  A request never migrates once routed — except under
+``DISAGG=on``, where it migrates exactly once by design: a prefill
+replica computes the prompt's KV, the finished pages ship to an
+affinity-chosen decode replica through ``serving/disagg.py``'s transport
+seam, and the request resumes there token-identically (any handoff
+failure finishes fused on the prefill replica instead).
 
 Replicas have a lifecycle (active | draining | drained | spare): ``drain``
 stops admission, lets in-flight work finish, and writes cached pages back
@@ -40,6 +45,7 @@ from githubrepostorag_tpu.resilience.faults import InjectedFault, fire_async
 from githubrepostorag_tpu.resilience.policy import get_breaker
 from githubrepostorag_tpu.serving.async_engine import AsyncEngine, StreamEvent
 from githubrepostorag_tpu.serving.chain_hash import chain_hashes
+from githubrepostorag_tpu.serving.disagg import InProcessTransport, assign_roles
 from githubrepostorag_tpu.serving.engine import Engine, GenerationResult
 from githubrepostorag_tpu.serving.routing import (AFFINITY_LOAD_SLACK,
                                                   score_prefix, weighted_load)
@@ -132,6 +138,19 @@ class MultiAsyncEngine:
         for ae in self._engines:
             metrics.FLEET_LIFECYCLE.labels(replica=ae.replica).set(
                 _LIFECYCLE_GAUGE[ae.lifecycle])
+        # disaggregated prefill/decode split (serving/disagg.py): roles are
+        # assigned once at fleet construction; the handoff counters and
+        # transport live here because the router owns the request lifecycle
+        # the handoff threads through
+        self._disagg = assign_roles(self._engines, get_settings())
+        self._transport = (
+            InProcessTransport(get_settings().disagg_transfer_burst)
+            if self._disagg else None
+        )
+        self._handoffs = 0
+        self._handoff_pages_shipped = 0
+        self._handoff_pages_deduped = 0
+        self._handoff_fallbacks: dict[str, int] = {}
         from githubrepostorag_tpu.obs.slo import get_slo_plane
 
         get_slo_plane().set_router_info(self.router_stats)
@@ -226,13 +245,20 @@ class MultiAsyncEngine:
             for ae in self._engines
         )
 
-    def _pick(self, prompt_ids: list[int]) -> tuple[AsyncEngine, bool]:
+    def _pick(self, prompt_ids: list[int],
+              roles: tuple[str, ...] | None = None) -> tuple[AsyncEngine, bool]:
         """Choose a replica; returns (target, breaker_granted).
 
         Ranking first, breaker second: ``allow()`` consumes the single
         half-open probe, so it is only asked about the replica we are about
-        to use — probing every candidate would wedge the ones not chosen."""
-        cands = [ae for ae in self._engines if ae.lifecycle == "active"]
+        to use — probing every candidate would wedge the ones not chosen.
+        ``roles`` restricts candidates under disaggregation; when every
+        replica of the wanted role is gone, any active replica still
+        serves the request fused rather than failing it."""
+        cands = [ae for ae in self._engines if ae.lifecycle == "active"
+                 and (roles is None or ae.role in roles)]
+        if not cands and roles is not None:
+            cands = [ae for ae in self._engines if ae.lifecycle == "active"]
         if not cands:
             raise RuntimeError("no active replicas (all drained or spare)")
 
@@ -351,7 +377,28 @@ class MultiAsyncEngine:
         # engines generate per-engine "req-N" ids that would collide across
         # replicas; mint a process-unique id when the caller didn't
         rid = request_id or f"mreq-{next(self._ids)}"
-        target, granted = self._pick(prompt_ids)
+        if self._disagg:
+            events = self._stream_disagg(prompt_ids, sampling, rid,
+                                         deadline_s, priority)
+        else:
+            target, granted = self._pick(prompt_ids)
+            events = self._stream_on(target, granted, prompt_ids, sampling,
+                                     rid, deadline_s, priority)
+        async for event in events:
+            yield event
+
+    async def _stream_on(
+        self,
+        target: AsyncEngine,
+        granted: bool,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None,
+        rid: str,
+        deadline_s: float | None,
+        priority: str,
+    ) -> AsyncIterator[StreamEvent]:
+        """Run ``rid`` on the already-picked ``target``, owning the route
+        map, pending-claim, and breaker bookkeeping end to end."""
         self._route[rid] = target
         self._pending[target.replica] += 1
         admitted = False
@@ -412,6 +459,212 @@ class MultiAsyncEngine:
         if target is not None:
             await target.cancel(request_id)
 
+    # ------------------------------------------------------ disagg handoff
+
+    async def _stream_disagg(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None,
+        rid: str,
+        deadline_s: float | None,
+        priority: str,
+    ) -> AsyncIterator[StreamEvent]:
+        """Prefill on a prefill replica, ship the KV, decode elsewhere.
+
+        The prefill pass is a 1-token greedy request: its sampled token is
+        discarded — the full prefix pages it leaves in the prefill
+        replica's cache are the product.  The decode replica re-admits the
+        ORIGINAL request against the shipped pages (``share`` + the warmed
+        fault-in scatters), recomputes only the tail partial page, and
+        emits every token the fused path would have: sampling never sees
+        different logits, so the two modes are token-identical.  Any
+        failure before the decode replica has emitted anything finishes
+        the request fused on the prefill replica instead — which holds the
+        whole prefix in its own cache, so the retry's prefill is nearly
+        free."""
+        # disagg fleets are page-size-homogeneous (assign_roles requires
+        # every replica tiered); chain hashes computed at this page size
+        # are the identity on BOTH ends of the wire
+        ps = self._engines[0].engine.page_size
+        # only FULL pages ship: the tail partial page (and the page the
+        # prompt's last token lands on) is recomputed by the decode
+        # replica's admission — same cap share() itself applies
+        shippable = max(0, (len(prompt_ids) - 1) // ps)
+        if shippable == 0:
+            # nothing a peer could reuse: skip the handoff, a decode
+            # replica does its own (tiny) prefill
+            target, tgrant = self._pick(prompt_ids, roles=("decode",))
+            async for event in self._stream_on(
+                target, tgrant, prompt_ids, sampling, rid, deadline_s,
+                priority,
+            ):
+                yield event
+            return
+        pre, granted = self._pick(prompt_ids, roles=("prefill",))
+        if pre.role != "prefill":
+            # the prefill tier is gone and _pick fell back: serve fused
+            # on whatever it chose
+            async for event in self._stream_on(
+                pre, granted, prompt_ids, sampling, rid, deadline_s,
+                priority,
+            ):
+                yield event
+            return
+        hashes = chain_hashes(prompt_ids, ps)[:shippable]
+
+        pre_sampling = SamplingParams(temperature=0.0, max_tokens=1)
+        final = None
+        try:
+            async for event in self._stream_on(
+                pre, granted, prompt_ids, pre_sampling, f"{rid}-pre",
+                deadline_s, priority,
+            ):
+                if event.type == "final":
+                    final = event.result
+        except Exception as exc:
+            # the prefill replica itself failed: retry fused anywhere
+            self._handoff_fallback("prefill_error")
+            _span().add_event("disagg.prefill.fault", error=str(exc))
+            target, tgrant = self._pick(prompt_ids)
+            async for event in self._stream_on(
+                target, tgrant, prompt_ids, sampling, rid, deadline_s,
+                priority,
+            ):
+                yield event
+            return
+        if final is None or final.finish_reason == "deadline":
+            # reaped mid-pass: the caller's budget is gone either way; let
+            # the fused path produce the authoritative deadline result
+            self._handoff_fallback("prefill_deadline")
+            async for event in self._fallback_fused(
+                pre, prompt_ids, sampling, rid, deadline_s, priority,
+            ):
+                yield event
+            return
+
+        dest, dgrant = self._pick_decode(hashes)
+        if dest is None:
+            self._handoff_fallback("no_decode_replica")
+            async for event in self._fallback_fused(
+                pre, prompt_ids, sampling, rid, deadline_s, priority,
+            ):
+                yield event
+            return
+
+        # ship only what the destination can't already serve: a decode
+        # replica holding the prefix content-hash-deduped pays nothing
+        res, hst = dest.digest.snapshot()
+        need = [h for h in hashes if h not in res and h not in hst]
+        try:
+            exported, stored = await self._transport.transfer(pre, dest, need)
+        except Exception as exc:  # InjectedFault or a dead peer
+            if dgrant:
+                # the granted half-open probe must resolve (cf. stream())
+                self._breakers[dest.replica].record_failure()
+            self._handoff_fallback("transfer_error")
+            _span().add_event("disagg.transfer.fault", decode=dest.replica,
+                              error=str(exc))
+            async for event in self._fallback_fused(
+                pre, prompt_ids, sampling, rid, deadline_s, priority,
+            ):
+                yield event
+            return
+
+        deduped = (len(hashes) - len(need)) + (exported - stored)
+        self._handoffs += 1
+        self._handoff_pages_shipped += stored
+        self._handoff_pages_deduped += deduped
+        metrics.DISAGG_HANDOFFS.labels(outcome="shipped").inc()
+        if stored:
+            metrics.DISAGG_PAGES.labels(kind="shipped").inc(stored)
+        if deduped:
+            metrics.DISAGG_PAGES.labels(kind="deduped").inc(deduped)
+        _span().add_event("disagg.handoff", prefill=pre.replica,
+                          decode=dest.replica, shipped=stored,
+                          deduped=deduped)
+
+        yielded = False
+        try:
+            async for event in self._stream_on(
+                dest, dgrant, prompt_ids, sampling, rid, deadline_s,
+                priority,
+            ):
+                yielded = True
+                yield event
+            return
+        except Exception:
+            if yielded:
+                # tokens already reached the caller: replaying from the
+                # prefill replica would duplicate them — surface the error
+                raise
+            self._handoff_fallback("decode_error")
+        async for event in self._fallback_fused(
+            pre, prompt_ids, sampling, rid, deadline_s, priority,
+        ):
+            yield event
+
+    async def _fallback_fused(
+        self, pre: AsyncEngine, prompt_ids, sampling, rid, deadline_s,
+        priority,
+    ) -> AsyncIterator[StreamEvent]:
+        """Finish ``rid`` fused on the prefill replica that already holds
+        its prefix (the handoff's universal escape hatch)."""
+        granted = self._breakers[pre.replica].allow()
+        async for event in self._stream_on(
+            pre, granted, prompt_ids, sampling, rid, deadline_s, priority,
+        ):
+            yield event
+
+    def _pick_decode(self, hashes: list[bytes]) -> tuple[AsyncEngine | None, bool]:
+        """Decode-side target: longest matchable run of the shipped hashes
+        first (a replica already holding the prefix imports nothing), then
+        limiter-weighted load.  Mirrors ``_pick``'s ranking-then-breaker
+        fail-open; returns (None, False) only when no decode replica is
+        active."""
+        cands = [ae for ae in self._engines
+                 if ae.lifecycle == "active" and ae.role == "decode"]
+        if not cands:
+            return None, False
+
+        def key(ae: AsyncEngine) -> tuple[float, float]:
+            _, _, score = score_prefix(hashes, *ae.digest.snapshot())
+            return (-score, weighted_load(self._load(ae),
+                                          ae.ledger.current_limiter()))
+
+        ranked = sorted(cands, key=key)
+        target, granted = ranked[0], False
+        for ae in ranked:
+            if self._breakers[ae.replica].allow():
+                target, granted = ae, True
+                break
+            self._count("skipped_breaker_open")
+        self._routed[target.replica] += 1
+        metrics.ROUTER_ROUTED.labels(replica=target.replica).inc()
+        return target, granted
+
+    def _handoff_fallback(self, reason: str) -> None:
+        self._handoff_fallbacks[reason] = (
+            self._handoff_fallbacks.get(reason, 0) + 1)
+        metrics.DISAGG_HANDOFFS.labels(outcome=f"fallback_{reason}").inc()
+        _span().add_event("disagg.fallback", reason=reason)
+
+    def disagg_stats(self) -> dict[str, Any]:
+        """Handoff economics + role census (router_stats and /debug/fleet
+        render this)."""
+        return {
+            "enabled": self._disagg,
+            "prefill_replicas": [ae.replica for ae in self._engines
+                                 if ae.role == "prefill"],
+            "decode_replicas": [ae.replica for ae in self._engines
+                                if ae.role == "decode"],
+            "handoffs": self._handoffs,
+            "pages_shipped": self._handoff_pages_shipped,
+            "pages_deduped": self._handoff_pages_deduped,
+            "fallbacks": dict(self._handoff_fallbacks),
+            "transport": (self._transport.payload()
+                          if self._transport is not None else None),
+        }
+
     # ------------------------------------------------------------ reading --
 
     def router_stats(self) -> dict[str, Any]:
@@ -423,6 +676,7 @@ class MultiAsyncEngine:
             routed = self._routed[r]
             per[r] = {
                 "lifecycle": ae.lifecycle,
+                "role": ae.role,
                 "routed": routed,
                 "prefix_hit_rate": self._prefix_hits[r] / max(1, routed),
                 "matched_resident_pages": self._matched_resident[r],
@@ -435,30 +689,50 @@ class MultiAsyncEngine:
             "policy": self._policy or get_settings().route_affinity,
             "decisions": dict(self._decisions),
             "per_replica": per,
+            "disagg": self.disagg_stats(),
         }
 
-    def stats(self) -> dict[str, Any]:
-        per = [eng.stats() for eng in self._engines]
-        # union of keys; numeric values merge across replicas — counters
-        # SUM, but rate/ratio-style keys would turn into nonsense summed
-        # (two replicas at 0.8 acceptance are not at 1.6), so they merge
-        # by MEAN.  A non-numeric or replica-local stat stays visible
-        # under per_replica.
-        keys = sorted(set().union(*(s.keys() for s in per)))
+    @staticmethod
+    def _merge_rows(rows: list[dict], mean_rows: list[dict] | None = None
+                    ) -> dict[str, Any]:
+        """Union of keys; numeric values merge across replicas — counters
+        SUM, but rate/ratio-style keys would turn into nonsense summed
+        (two replicas at 0.8 acceptance are not at 1.6), so they merge by
+        MEAN — over ``mean_rows`` when given: the fleet merge passes only
+        decode-capable replicas there, so a prefill-only replica's idle
+        decode-side rates don't drag the fleet means.  A non-numeric or
+        replica-local stat stays visible under per_replica."""
+        mean_rows = rows if mean_rows is None else mean_rows
+        keys = sorted(set().union(*(s.keys() for s in rows))) if rows else []
         merged: dict[str, Any] = {}
         for key in keys:
+            is_mean = key.endswith(("_rate", "_ratio", "_utilization"))
             nums = [
-                s[key] for s in per
+                s[key] for s in (mean_rows if is_mean else rows)
                 if isinstance(s.get(key), (int, float))
                 and not isinstance(s.get(key), bool)
             ]
             if nums:
-                if key.endswith(("_rate", "_ratio", "_utilization")):
-                    merged[key] = sum(nums) / len(nums)
-                else:
-                    merged[key] = sum(nums)
+                merged[key] = sum(nums) / len(nums) if is_mean else sum(nums)
+        return merged
+
+    def stats(self) -> dict[str, Any]:
+        per = [eng.stats() for eng in self._engines]
+        roles = [s.get("role", "fused") for s in per]
+        # prefill-specialized replicas never decode: excluding them from
+        # the mean-merged keys keeps fleet TPOT/acceptance honest (on a
+        # fused fleet every role is "fused", so this is the old merge)
+        decodeish = [s for s, r in zip(per, roles) if r != "prefill"] or per
+        merged = self._merge_rows(per, mean_rows=decodeish)
         merged["replicas"] = len(per)
         merged["per_replica"] = per
+        if getattr(self, "_disagg", False):
+            by_role: dict[str, list[dict]] = {}
+            for s, r in zip(per, roles):
+                by_role.setdefault(r, []).append(s)
+            merged["per_role"] = {
+                r: self._merge_rows(rows) for r, rows in by_role.items()
+            }
         if hasattr(self, "_decisions"):  # absent on bare merge-rule stubs
             merged["router"] = self.router_stats()
         return merged

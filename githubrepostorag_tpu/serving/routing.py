@@ -22,11 +22,15 @@ HOST_WEIGHT = 0.6
 # Fallback weighting: a replica's windowed limiter attribution (obs/ledger)
 # expressed as equivalent extra queue depth.  A replica limited by
 # `hbm_pages` or `swap_wait` is a bad target even with a short queue — new
-# admissions there wait on page churn, not compute.  `compile` is transient
-# but poisons TTFT while it lasts; `stall` is mild host-side friction.
+# admissions there wait on page churn, not compute.  `kv_transfer` means
+# the replica's driver is busy packing/unpacking disaggregated handoffs
+# between steps — worse than mild stall, milder than page starvation.
+# `compile` is transient but poisons TTFT while it lasts; `stall` is mild
+# host-side friction.
 LIMITER_PENALTY = {
     "hbm_pages": 8.0,
     "swap_wait": 6.0,
+    "kv_transfer": 5.0,
     "compile": 3.0,
     "stall": 1.0,
     "none": 0.0,

@@ -78,8 +78,8 @@ def build_payloads():
 
     plane = SLOPlane()  # a private plane: no admission-hint registration
     plane.register("r0", ledger=ledger, monitor=monitor,
-                   stats=lambda: {"num_running": 0, "num_waiting": 0,
-                                  "free_pages": 32},
+                   stats=lambda: {"role": "fused", "num_running": 0,
+                                  "num_waiting": 0, "free_pages": 32},
                    digest=digest)
     # the same shape MultiAsyncEngine.router_stats() renders (the router
     # registers it via SLOPlane.set_router_info)
@@ -88,11 +88,24 @@ def build_payloads():
         "decisions": {"affinity_hit": 1, "affinity_miss": 1,
                       "skipped_breaker_open": 0, "skipped_limiter": 0},
         "per_replica": {"r0": {
-            "lifecycle": "active", "routed": 2, "prefix_hit_rate": 0.5,
+            "lifecycle": "active", "role": "fused", "routed": 2,
+            "prefix_hit_rate": 0.5,
             "matched_resident_pages": 3, "matched_host_pages": 1,
             "pending": 0, "breaker": "closed",
             "digest": digest.payload(),
         }},
+        # MultiAsyncEngine.disagg_stats(): handoff economics + role census
+        "disagg": {
+            "enabled": True,
+            "prefill_replicas": ["r0"],
+            "decode_replicas": ["r1"],
+            "handoffs": 1,
+            "pages_shipped": 4,
+            "pages_deduped": 2,
+            "fallbacks": {"transfer_error": 1},
+            "transport": {"kind": "in_process", "burst": 32,
+                          "transfers": 1, "chunks": 1},
+        },
     })
     return plane.slo_payload(), plane.fleet_payload()
 

@@ -48,6 +48,15 @@ echo "== fleet-routing A/B (CPU-tiny) =="
 # digest publishing active.
 BENCH_ONLY=routing JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
 
+echo "== disaggregated-serving A/B (CPU-tiny) =="
+# fused vs disaggregated prefill/decode over identical 3-replica fleets
+# at the same offered load (65% of recalibrated fused capacity, Poisson
+# arrivals): bench_disagg_pair asserts decode TPOT p99 at or under fused
+# in the median of 5 paired back-to-back trials, window goodput within
+# noise, token-identical outputs, zero live-traffic XLA recompiles, and
+# the kv_transfer accounting + wire seconds inside the 2% obs budget.
+BENCH_ONLY=disagg JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -183,6 +183,14 @@ def build_table(records: list[dict], driver_name: str,
         ("KV tiering goodput, device-only / tiered (CPU A/B)",
          ["kv_tier_conc128_cpu_goodput_tok_s_device",
           "kv_tier_conc128_cpu_goodput_tok_s_tiered"], "tok/s"),
+        ("Disagg conc256 decode TPOT p99, fused / disagg (CPU A/B)",
+         ["disagg_conc256_cpu_tpot_p99_ms_fused",
+          "disagg_conc256_cpu_tpot_p99_ms_disagg"], "ms"),
+        ("Disagg conc256 TPOT p99 speedup, median paired trial (CPU A/B)",
+         ["disagg_conc256_cpu_tpot_p99_speedup_vs_fused"], "×"),
+        ("Disagg conc256 goodput, fused / disagg (CPU A/B)",
+         ["disagg_conc256_cpu_goodput_tok_s_fused",
+          "disagg_conc256_cpu_goodput_tok_s_disagg"], "tok/s"),
         ("Qwen2-MoE 16-expert decode, bs=8 (beyond-reference)",
          ["decode_tok_s_per_chip_qwen2-moe-16e_bs8"], "tok/s"),
         ("Qwen2-MoE 16-expert INT8 decode, bs=8",
@@ -208,7 +216,7 @@ def render(root: pathlib.Path = ROOT, driver_name: str | None = None) -> str:
     # metrics a TPU-run BENCH_SUMMARY.json doesn't — appended AFTER the
     # summary records so the committed A/B wins any same-name collision
     for artifact in ("BENCH_retrieval_cpu.json", "BENCH_spec_cpu.json",
-                     "BENCH_kv_tier_cpu.json"):
+                     "BENCH_kv_tier_cpu.json", "BENCH_disagg_cpu.json"):
         path = root / artifact
         if path.exists():
             records += json.loads(path.read_text())["records"]

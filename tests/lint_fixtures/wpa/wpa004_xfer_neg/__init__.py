@@ -1,0 +1,3 @@
+"""WPA004 transfer negative: export/import done right — every exported
+handle reaches exactly one import (or a release on the abandon path) and
+the source copy is released after the landing."""

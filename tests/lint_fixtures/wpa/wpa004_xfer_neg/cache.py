@@ -1,0 +1,18 @@
+from wpa004_xfer_neg.pool import PagePool
+
+
+class Handoff:
+    def __init__(self):
+        self.src_pool = PagePool()
+        self.dst_pool = PagePool()
+
+    def ship(self, n):
+        pages = self.src_pool.allocate(n)
+        self.src_pool.export_pages(pages)  # in flight toward the peer
+        self.dst_pool.import_pages(pages)  # exactly one landing
+        self.src_pool.release(pages)  # source copy reclaimed
+
+    def abandoned(self, n):
+        pages = self.src_pool.allocate(n)
+        self.src_pool.export_pages(pages)
+        self.src_pool.release(pages)  # transfer gave up: legal close

@@ -1,0 +1,3 @@
+"""WPA004 transfer positive: an export dropped without ever landing
+(dangling export), a payload imported twice (double-import), and an
+export of already-released pages (use-after-release)."""

@@ -1,0 +1,23 @@
+from wpa004_xfer_pos.pool import PagePool
+
+
+class Handoff:
+    def __init__(self):
+        self.src_pool = PagePool()
+        self.dst_pool = PagePool()
+
+    def drop_in_flight(self, n):
+        pages = self.src_pool.allocate(n)
+        self.src_pool.export_pages(pages)
+        return None  # dangling export: never imported nor released
+
+    def double_land(self, n):
+        pages = self.src_pool.allocate(n)
+        self.src_pool.export_pages(pages)
+        self.dst_pool.import_pages(pages)
+        self.dst_pool.import_pages(pages)  # second landing clobbers the first
+
+    def export_freed(self, n):
+        pages = self.src_pool.allocate(n)
+        self.src_pool.release(pages)
+        self.src_pool.export_pages(pages)  # ships pages already reused

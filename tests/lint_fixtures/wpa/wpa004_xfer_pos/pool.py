@@ -1,0 +1,24 @@
+class OutOfPages(Exception):
+    pass
+
+
+class PagePool:
+    def __init__(self, n=8):
+        self.free = list(range(n))
+        self.inflight = []
+
+    def allocate(self, n):
+        if n > len(self.free):
+            raise OutOfPages()
+        out, rest = self.free[:n], self.free[n:]
+        self.free = rest
+        return out
+
+    def export_pages(self, pages):
+        self.inflight.extend(pages)
+
+    def import_pages(self, pages):
+        self.inflight = [p for p in self.inflight if p not in pages]
+
+    def release(self, pages):
+        self.free.extend(pages)

@@ -1,0 +1,2 @@
+"""WPA004 transfer suppressed: the dangling-export shape silenced with a
+justified directive at the return site."""

@@ -1,0 +1,13 @@
+from wpa004_xfer_sup.pool import PagePool
+
+
+class Handoff:
+    def __init__(self):
+        self.src_pool = PagePool()
+        self.dst_pool = PagePool()
+
+    def replicate(self, n):
+        pages = self.src_pool.allocate(n)
+        self.src_pool.export_pages(pages)
+        # tpulint: disable=WPA004 -- fire-and-forget replication: the peer acks asynchronously and the janitor sweep releases unacked exports in bulk
+        return None

@@ -765,3 +765,57 @@ async def test_replica_death_during_drain_still_resolves(tiny_model, monkeypatch
         assert stats["r1"]["routed"] >= 1  # survivor took the traffic
     finally:
         await multi.stop()
+
+
+async def test_decode_replica_death_mid_handoff_finishes_fused(
+        tiny_model, monkeypatch):
+    """FAULTS kills the KV transfer mid-handoff (``disagg.transfer:error``
+    — where a dead decode peer or a downed link surfaces): the request
+    must still finish, token-identical, fused on the prefill replica that
+    already holds its prefix, with the fallback accounted and the decode
+    replica's breaker debited."""
+    import jax.numpy as jnp
+
+    from githubrepostorag_tpu.serving import Engine, SamplingParams
+    from githubrepostorag_tpu.serving.multi_engine import MultiAsyncEngine
+
+    params, cfg = tiny_model
+
+    def _eng():
+        return Engine(params, cfg, max_num_seqs=2, num_pages=32, page_size=4,
+                      max_seq_len=64, kv_dtype=jnp.float32,
+                      kv_tier="on", kv_host_pool_pages=32)
+
+    prompt = list(range(40, 58))  # 4 full shippable pages at page_size=4
+    sp = SamplingParams(temperature=0.0, max_tokens=6, stop_token_ids=())
+    expected = _eng().generate([prompt], sp)[0].output_tokens
+
+    monkeypatch.setenv("DISAGG", "on")
+    monkeypatch.setenv("DISAGG_PREFILL_REPLICAS", "1")
+    _enable(monkeypatch, "disagg.transfer:error")  # reloads settings too
+    multi = MultiAsyncEngine([_eng(), _eng()])
+    assert multi.disagg_stats()["enabled"]
+    try:
+        before = counter_value(FAULTS_INJECTED, site="disagg.transfer",
+                               action="error")
+        res = await multi.generate(prompt, sp)
+        assert res.output_tokens == expected  # fused fallback, same tokens
+        assert counter_value(FAULTS_INJECTED, site="disagg.transfer",
+                             action="error") == before + 1
+        ds = multi.disagg_stats()
+        assert ds["handoffs"] == 0
+        assert ds["fallbacks"]["transfer_error"] == 1
+        assert ds["pages_shipped"] == 0  # the wire died before any landing
+        # the decode peer ate the blame, not the prefill replica
+        assert get_breaker("replica-r1").snapshot()["consecutive_failures"] >= 1
+        assert get_breaker("replica-r0").snapshot()["consecutive_failures"] == 0
+
+        # with the fault cleared the very next request hands off cleanly
+        monkeypatch.setenv("FAULTS", "")
+        reload_settings()
+        reset_faults()
+        res = await multi.generate(prompt, sp)
+        assert res.output_tokens == expected
+        assert multi.disagg_stats()["handoffs"] == 1
+    finally:
+        await multi.stop()

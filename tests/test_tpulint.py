@@ -249,6 +249,33 @@ def test_wpa004_tier_suppressed_is_silenced_with_justification():
     assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
 
 
+# Disaggregated serving extends the WPA004 alphabet again: export_pages()
+# puts a handle in flight toward a peer pool and import_pages() lands it.
+# The checker must prove every export reaches exactly one import or a
+# release — dangling exports, double-imports, and transfers of released
+# handles all fire; the clean handoff (and the abandon path) stay silent.
+
+def test_wpa004_xfer_positive_catches_all_three_shapes():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_xfer_pos"])
+    messages = [f.message for f in findings if f.rule == "WPA004"]
+    assert any("dangling export" in m for m in messages), messages
+    assert any("double-import" in m for m in messages), messages
+    assert any("use-after-release" in m for m in messages), messages
+
+
+def test_wpa004_xfer_negative_is_silent():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_xfer_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_wpa004_xfer_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_xfer_sup"])
+    hits = [f for f in findings if f.rule == "WPA004"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
 # The fleet router reads per-replica chain digests the driver thread
 # updates every step (serving/routing.py).  These fixtures pin the exact
 # cross-domain shape: an event-loop pick path consuming a driver-written

@@ -51,6 +51,10 @@ Rules:
   ``allocate``/``share`` reaches exactly one commit/``release`` — flag
   leaks via early return/raise between alloc and commit, double-frees,
   and committed page attributes that no release path ever reads back.
+  The alphabet includes disaggregated-transfer transitions: an
+  ``export_pages``'d handle is in flight and must reach exactly one
+  ``import_pages`` or a release — dangling exports, double-imports, and
+  transfers of released handles all fire.
 
 Everything here is stdlib-``ast`` only and runs in one pass over already
 parsed trees, so ``make lint`` stays fast.
@@ -877,9 +881,18 @@ _RELEASE_METHODS = {"release", "recycle", "free"}
 # transitions — but applying one to an already-released handle is
 # use-after-free of pool state
 _TIER_METHODS = {"evict", "fault_in"}
+# disaggregated handoff transfers: export packs a handle's pages for a
+# peer pool, import lands them there.  An exported handle is in flight —
+# it must reach exactly one import (the peer now owns the payload) or a
+# release (the transfer was abandoned); dropping it strands pages on both
+# ends, and importing it twice double-lands the payload (the second
+# import clobbers whatever the peer did with the first)
+_EXPORT_METHODS = {"export_pages", "export_kv_pages"}
+_IMPORT_METHODS = {"import_pages", "import_kv_pages"}
 _POOLISH_RE = re.compile(r"alloc|pool|page", re.IGNORECASE)
 
 OWNED, MAYBE, RELEASED, ESCAPED = "owned", "maybe", "released", "escaped"
+EXPORTED, IMPORTED = "exported", "imported"
 
 
 def _pool_classes(program: Program) -> set[str]:
@@ -904,7 +917,8 @@ class _PoolOps:
         if d is None:
             return None
         last = d.rsplit(".", 1)[-1]
-        if last not in _ALLOC_METHODS | _RELEASE_METHODS | _TIER_METHODS:
+        if last not in (_ALLOC_METHODS | _RELEASE_METHODS | _TIER_METHODS
+                        | _EXPORT_METHODS | _IMPORT_METHODS):
             return None
         resolved = self.program._resolve_dotted_call(d, self.fn)
         is_pool = any(m.cls is not None and m.cls.qualname in self.pools
@@ -918,6 +932,10 @@ class _PoolOps:
             return "alloc"
         if last in _TIER_METHODS:
             return "tier"
+        if last in _EXPORT_METHODS:
+            return "export"
+        if last in _IMPORT_METHODS:
+            return "import"
         return "release"
 
 
@@ -964,7 +982,10 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
                         f"'{fn.qualname}' — pages already returned to the "
                         f"pool (refcount corruption / page reuse)",
                     ))
-                elif state in {OWNED, MAYBE}:
+                elif state in {OWNED, MAYBE, EXPORTED, IMPORTED}:
+                    # releasing an exported handle is the abandon path of
+                    # a failed transfer; releasing an imported one ends
+                    # the handle's life normally — both are legal closes
                     env[arg.id] = RELEASED
                 res.release_attrs.update(derived_from.get(arg.id, ()))
             elif isinstance(arg, ast.Attribute):
@@ -988,6 +1009,48 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
                     f"to another request",
                 ))
 
+    def handle_export(call: ast.Call, env: dict[str, str]) -> None:
+        # export packs the handle's pages for a peer: ownership stays here
+        # but the handle is now in flight and must reach exactly one
+        # import or a release.  Exporting released pages ships payloads
+        # that may already belong to another request.
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                state = env.get(arg.id)
+                if state == RELEASED:
+                    res.findings.append((
+                        call.lineno, call.col_offset,
+                        f"use-after-release: '{arg.id}' exported in "
+                        f"'{fn.qualname}' after its pages were released — "
+                        f"the transfer ships pages that may already belong "
+                        f"to another request",
+                    ))
+                elif state in {OWNED, MAYBE}:
+                    env[arg.id] = EXPORTED
+
+    def handle_import(call: ast.Call, env: dict[str, str]) -> None:
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                state = env.get(arg.id)
+                if state == IMPORTED:
+                    res.findings.append((
+                        call.lineno, call.col_offset,
+                        f"double-import: '{arg.id}' imported again in "
+                        f"'{fn.qualname}' — the transfer already landed; a "
+                        f"second import clobbers whatever the destination "
+                        f"pool did with the first copy",
+                    ))
+                elif state == RELEASED:
+                    res.findings.append((
+                        call.lineno, call.col_offset,
+                        f"use-after-release: '{arg.id}' imported in "
+                        f"'{fn.qualname}' after its pages were released — "
+                        f"the destination lands pages that may already "
+                        f"belong to another request",
+                    ))
+                elif state in {OWNED, MAYBE, EXPORTED}:
+                    env[arg.id] = IMPORTED
+
     def handle_calls(stmt: ast.AST, env: dict[str, str]) -> None:
         """Release calls + owned-var escapes through arbitrary calls."""
         for node in ast.walk(stmt):
@@ -998,9 +1061,13 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
                 handle_release(node, env)
             elif kind == "tier":
                 handle_tier(node, env)
+            elif kind == "export":
+                handle_export(node, env)
+            elif kind == "import":
+                handle_import(node, env)
             elif kind is None:
                 for name in names_read(node):
-                    if env.get(name) in {OWNED, MAYBE}:
+                    if env.get(name) in {OWNED, MAYBE, EXPORTED}:
                         env[name] = ESCAPED
 
     def leak_check(line: int, col: int, env: dict[str, str], what: str) -> None:
@@ -1012,6 +1079,15 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
                     f"{alloc_line.get(var, '?')}) is still owned when "
                     f"'{fn.qualname}' {what} — pages never return to the "
                     f"pool and the cache fills until OutOfPages",
+                ))
+                env[var] = ESCAPED  # report once
+            elif env[var] == EXPORTED:
+                res.findings.append((
+                    line, col,
+                    f"dangling export: '{var}' is still in flight when "
+                    f"'{fn.qualname}' {what} — an exported handle must "
+                    f"reach exactly one import or release; dropping it "
+                    f"strands the pages on both ends of the transfer",
                 ))
                 env[var] = ESCAPED  # report once
 
@@ -1092,7 +1168,7 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
         if isinstance(stmt, ast.Return):
             handle_calls(stmt, env)
             for n in names_read(stmt.value):
-                if env.get(n) in {OWNED, MAYBE}:
+                if env.get(n) in {OWNED, MAYBE, EXPORTED}:
                     env[n] = ESCAPED  # ownership transferred to caller
             leak_check(stmt.lineno, stmt.col_offset, env, "returns")
             return env
@@ -1227,7 +1303,12 @@ _register_program_rule(
     "Every path from a page-pool allocate()/share() must reach exactly "
     "one commit or release(): an early return/raise that drops an owned "
     "page handle leaks device pages until OutOfPages; releasing twice "
-    "corrupts refcounts and recycles live pages.",
+    "corrupts refcounts and recycles live pages. Transfer transitions "
+    "extend the alphabet: export_pages() puts a handle in flight toward "
+    "a peer pool, and it must then reach exactly one import_pages() or a "
+    "release — dropping it strands pages on both ends, importing twice "
+    "double-lands the payload, and exporting/importing released pages "
+    "ships memory that may already belong to another request.",
 )
 
 
