@@ -826,6 +826,140 @@ def bench_retrieval_pair(tag: str, *, n_docs: int, dim: int, concurrency: int,
     return {"speedup": speedup, **{p: out[p] for p in out}}
 
 
+def bench_liveindex_pair(tag: str, *, n_docs: int = 8192, dim: int = 256,
+                         concurrency: int = 16, queries_per_thread: int = 24,
+                         k: int = 8, apply_batch: int = 64,
+                         trials: int = 3) -> dict:
+    """``liveindex_conc16``: query latency on an idle device index vs the
+    SAME closed-loop load while a full re-index streams through the
+    mutation log (PR-13).  A = ``concurrency`` threads run top-k searches
+    over a warmed ``DeviceIndexedStore``.  B = the identical threads and
+    query set while a producer appends a complete re-upsert of the corpus
+    (same doc ids -> rows update in place, capacity bucket and scatter
+    shapes already warmed) to a ``MutationLog`` that a background
+    ``LiveIndexApplier`` drains into the bucketed scatter path between
+    query waves.  Hard gates, all asserted: doc-id parity before timing,
+    live p95 <= 1.5x idle p95 (medians of ``trials``), ZERO live XLA
+    compiles across every live phase (search AND mutation program caches),
+    the applier fully caught up per trial with no whole-table transpose
+    re-put (full_syncs), and watermark-gauge publishing inside the 2%
+    observability budget."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+    from statistics import median
+
+    from githubrepostorag_tpu.ingest.stream import MutationLog
+    from githubrepostorag_tpu.retrieval import DeviceIndexedStore, LiveIndexApplier
+    from githubrepostorag_tpu.store.base import Doc
+    from githubrepostorag_tpu.store.memory import MemoryVectorStore
+
+    table = "bench_liveindex"
+    rng = np.random.default_rng(23)
+    vecs = rng.standard_normal((n_docs, dim)).astype(np.float32)
+    docs = [Doc(f"d{i}", f"chunk {i}", {"namespace": "bench",
+                                        "repo": f"repo{i % 7}"}, vecs[i])
+            for i in range(n_docs)]
+    host = MemoryVectorStore()
+    host.upsert(table, docs)
+    dstore = DeviceIndexedStore(MemoryVectorStore(), k_bucket=max(16, k),
+                                max_wave=concurrency)
+    dstore.upsert(table, docs)
+    log(f"bench[{tag}]: warmup (query buckets + mutation ladder)")
+    dstore.warmup()
+
+    n_q = concurrency * queries_per_thread
+    queries = rng.standard_normal((n_q, dim)).astype(np.float32)
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+    chunks = [queries[t::concurrency] for t in range(concurrency)]
+
+    # parity gate before any timing: device path must match the host scan
+    for q in queries[:4]:
+        a = [h.doc.doc_id for h in host.search(table, q, k)]
+        b = [h.doc.doc_id for h in dstore.search(table, q, k)]
+        assert a == b, f"live-index parity broke: {a} vs {b}"
+
+    def run_queries() -> list[float]:
+        lats: list[float] = []
+
+        def worker(qs) -> None:
+            for q in qs:
+                t0 = time.monotonic()
+                dstore.search(table, q, k)
+                lats.append(time.monotonic() - t0)
+
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            list(pool.map(worker, chunks))
+        lats.sort()
+        return lats
+
+    def p95(lats: list[float]) -> float:
+        return lats[min(len(lats) - 1, int(len(lats) * 0.95))]
+
+    run_queries()  # untimed warm pass: jit reuse check, thread spin-up
+    mlog = MutationLog()
+    applier = LiveIndexApplier(mlog, dstore, apply_batch=apply_batch,
+                               compact_interval_s=1.0)
+    full_syncs0 = dstore.health()["device_index"][table]["full_syncs"]
+    search0 = dstore.search_program_cache_size()
+    mutation0 = dstore.mutation_program_cache_size()
+    idle_p95s: list[float] = []
+    live_p95s: list[float] = []
+    live_walls: list[float] = []
+    reindex_rates: list[float] = []
+    applier.start()
+    try:
+        for _ in range(trials):
+            idle_p95s.append(p95(run_queries()))        # A: idle index
+
+            def producer() -> None:
+                for i in range(0, n_docs, apply_batch):
+                    mlog.append_upsert(table, docs[i:i + apply_batch])
+
+            pt = threading.Thread(target=producer)
+            t0 = time.monotonic()
+            pt.start()
+            live_p95s.append(p95(run_queries()))        # B: live re-index
+            live_walls.append(time.monotonic() - t0)
+            pt.join()
+            assert applier.flush(timeout=120.0), "applier never caught up"
+            reindex_rates.append(n_docs / (time.monotonic() - t0))
+    finally:
+        applier.stop()
+    # zero-live-compile + in-place-update contract over every live phase
+    assert dstore.search_program_cache_size() == search0, \
+        f"live XLA compile on the search path under streaming ({tag})"
+    assert dstore.mutation_program_cache_size() == mutation0, \
+        f"live XLA compile on the mutation path under streaming ({tag})"
+    full_syncs = dstore.health()["device_index"][table]["full_syncs"]
+    assert full_syncs == full_syncs0, \
+        "streamed re-index fell back to a whole-table transpose re-put"
+    idle = median(idle_p95s)
+    live = median(live_p95s)
+    ratio = live / max(idle, 1e-9)
+    publish_pct = 100.0 * applier.publish_seconds() / max(sum(live_walls), 1e-9)
+    emit(f"{tag}_p95_ms_idle", idle * 1e3, "ms", None,
+         trial_p95_ms=[round(x * 1e3, 3) for x in idle_p95s])
+    emit(f"{tag}_p95_ms_live", live * 1e3, "ms", None,
+         trial_p95_ms=[round(x * 1e3, 3) for x in live_p95s])
+    emit(f"{tag}_p95_live_over_idle", ratio, "x", None)
+    emit(f"{tag}_reindex_docs_s", median(reindex_rates), "docs/s", None)
+    emit(f"{tag}_publish_overhead_pct", publish_pct, "%", None)
+    log(f"bench[{tag}]: p95 idle {idle * 1e3:.2f} ms vs live "
+        f"{live * 1e3:.2f} ms ({ratio:.2f}x, gate 1.5x); re-index "
+        f"{median(reindex_rates):.0f} docs/s; publish {publish_pct:.3f}% "
+        f"of live wall ({concurrency} threads x {queries_per_thread} "
+        f"queries, corpus {n_docs}x{dim})")
+    assert ratio <= 1.5, (
+        f"live re-index pushed query p95 to {ratio:.2f}x idle "
+        "(acceptance gate: <= 1.5x)")
+    assert publish_pct <= 2.0, (
+        f"watermark publishing took {publish_pct:.2f}% of live wall, "
+        "outside the 2% observability budget")
+    return {"ratio": ratio, "idle_p95": idle, "live_p95": live,
+            "reindex_docs_s": median(reindex_rates),
+            "publish_pct": publish_pct}
+
+
 def bench_spec_pair(tag: str, *, streams: int = 8, prompt_len: int = 32,
                     gen_tokens: int = 64, trials: int = 3) -> dict:
     """``spec_cpu``: draft-model speculative decoding vs plain decode
@@ -1775,6 +1909,43 @@ def _run_disagg_cpu(artifact_dir: str) -> None:
         log(f"bench: could not write BENCH_disagg_cpu.json ({exc})")
 
 
+def _run_liveindex_cpu(artifact_dir: str) -> None:
+    """Run the live-index streaming A/B and write its committed-artifact
+    JSON.  Same convention as the KV-tier, routing and disagg artifacts:
+    the full CPU run writes next to bench.py, BENCH_ONLY=liveindex CI
+    reruns write under artifacts/."""
+    if not budget_allows("liveindex_conc16_cpu", 180):
+        return
+    before = len(_RECORDS)
+    li = bench_liveindex_pair("liveindex_conc16_cpu")
+    recs = _RECORDS[before:]
+    try:
+        os.makedirs(artifact_dir, exist_ok=True)
+        with open(os.path.join(artifact_dir, "BENCH_liveindex_cpu.json"), "w") as f:
+            json.dump({
+                "scenario": ("liveindex_conc16 (CPU A/B; query p95 idle vs "
+                             "under streamed full re-index through the "
+                             "mutation log)"),
+                "platform": "cpu",
+                "note": (
+                    "16 closed-loop query threads over a warmed 8192x256 "
+                    "device index, idle vs while a producer streams a "
+                    "complete corpus re-upsert through MutationLog + "
+                    "LiveIndexApplier (64-doc batches, in-place row "
+                    "updates), 3-trial medians. Zero live XLA compiles "
+                    "on both program caches, zero full_syncs, asserted. "
+                    f"Live/idle p95: {li['ratio']:.2f}x (gate 1.5x); "
+                    f"re-index {li['reindex_docs_s']:.0f} docs/s; "
+                    f"watermark publishing {li['publish_pct']:.3f}% of "
+                    "live wall (2% obs budget)."),
+                "records": recs,
+                "summary": {r["metric"]: r["value"] for r in recs},
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        log(f"bench: could not write BENCH_liveindex_cpu.json ({exc})")
+
+
 def _main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -1787,7 +1958,8 @@ def _main() -> None:
     only = os.environ.get("BENCH_ONLY", "")
     if only:
         runners = {"kv_tier": _run_kv_tier_cpu, "routing": _run_routing_cpu,
-                   "disagg": _run_disagg_cpu}
+                   "disagg": _run_disagg_cpu,
+                   "liveindex": _run_liveindex_cpu}
         if only not in runners:
             log(f"bench: unknown BENCH_ONLY={only!r} "
                 f"(supported: {', '.join(sorted(runners))})")
@@ -1868,6 +2040,7 @@ def _main() -> None:
         _run_kv_tier_cpu(os.path.dirname(__file__) or ".")
         _run_routing_cpu(os.path.dirname(__file__) or ".")
         _run_disagg_cpu(os.path.dirname(__file__) or ".")
+        _run_liveindex_cpu(os.path.dirname(__file__) or ".")
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------

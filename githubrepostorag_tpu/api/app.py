@@ -115,6 +115,7 @@ class RagApi:
         app.router.add_get("/debug/traces/{trace_id}", self.debug_trace)
         app.router.add_get("/debug/slo", self.debug_slo)
         app.router.add_get("/debug/fleet", self.debug_fleet)
+        app.router.add_get("/debug/index", self.debug_index)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/", self.index_redirect)
@@ -295,6 +296,11 @@ class RagApi:
         from githubrepostorag_tpu.obs.slo import get_slo_plane
 
         return web.json_response(get_slo_plane().fleet_payload())
+
+    async def debug_index(self, request: web.Request) -> web.Response:
+        from githubrepostorag_tpu.retrieval.live_index import live_index_payload
+
+        return web.json_response(live_index_payload())
 
     async def health(self, request: web.Request) -> web.Response:
         import asyncio

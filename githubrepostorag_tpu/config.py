@@ -135,6 +135,24 @@ class Settings:
     # static k for the jitted top-k program; requests with k above this
     # fall back to the host store (counted in rag_device_index_searches_total)
     device_index_k_bucket: int = field(default_factory=lambda: _env_int("DEVICE_INDEX_K_BUCKET", 16))
+    # --- Live index (ingest/stream.py + retrieval/live_index.py) ---
+    # "on" routes store writes through the watermarked mutation log and
+    # starts the background apply loop + compactor (get_store() returns
+    # the LiveIndexedStore front); "off" (default) keeps direct writes.
+    live_index: str = field(default_factory=lambda: os.getenv("LIVE_INDEX", "off"))
+    # durable JSONL append file for the log; empty = in-memory only
+    # (DATA_DIR/mutation_log.jsonl when DATA_DIR is set)
+    live_index_log_path: str = field(default_factory=lambda: os.getenv("LIVE_INDEX_LOG_PATH", ""))
+    # max mutation ops per apply drain (one batch = one watermark advance)
+    live_index_apply_batch: int = field(default_factory=lambda: _env_int("LIVE_INDEX_APPLY_BATCH", 64))
+    # background compactor: idle-scan period, and the two hole triggers —
+    # absolute count OR fraction of the table's capacity bucket
+    index_compact_interval_s: float = field(
+        default_factory=lambda: _env_float("INDEX_COMPACT_INTERVAL_S", 5.0))
+    index_compact_min_holes: int = field(
+        default_factory=lambda: _env_int("INDEX_COMPACT_MIN_HOLES", 64))
+    index_compact_max_hole_fraction: float = field(
+        default_factory=lambda: _env_float("INDEX_COMPACT_MAX_HOLE_FRACTION", 0.25))
 
     # --- LLM serving (in-tree TPU engine; endpoint kept for split deploys) ---
     qwen_endpoint: str = field(default_factory=lambda: os.getenv("QWEN_ENDPOINT", "http://qwen:8000"))

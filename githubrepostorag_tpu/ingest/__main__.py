@@ -23,6 +23,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--namespace", default=None)
     parser.add_argument("--branch", default=None)
     parser.add_argument("--force", action="store_true", help="ignore resume sentinels")
+    parser.add_argument("--watch", action="store_true",
+                        help="with --local: keep polling the directory and "
+                             "re-ingest on change (streams through the live "
+                             "index when LIVE_INDEX=on)")
+    parser.add_argument("--watch-interval", type=float, default=2.0,
+                        help="seconds between --watch polls")
+    parser.add_argument("--watch-polls", type=int, default=None,
+                        help="stop --watch after N polls (default: forever)")
     args = parser.parse_args(argv)
 
     s = get_settings()
@@ -36,6 +44,31 @@ def main(argv: list[str] | None = None) -> int:
                 return 0
 
     from githubrepostorag_tpu.ingest.controller import ingest_component, ingest_many
+
+    if args.watch:
+        if not args.local:
+            parser.error("--watch requires --local")
+        from githubrepostorag_tpu.ingest.sources import LocalRepoReader
+        from githubrepostorag_tpu.ingest.stream import watch_local
+
+        name = (args.repo or [Path(args.local).resolve().name])[0]
+
+        def reingest() -> None:
+            docs = LocalRepoReader(args.local).load()
+            record = ingest_component(name, namespace=namespace, docs=docs,
+                                      branch=args.branch)
+            logger.info("watch: re-ingested %s (%s nodes)", name,
+                        record.get("nodes", "?"))
+
+        fired = watch_local(args.local, reingest,
+                            interval_s=args.watch_interval,
+                            max_polls=args.watch_polls)
+        print(json.dumps({"watch": args.local, "ingests": fired}))
+        if s.store_backend in ("memory", "native") and s.store_path:
+            from githubrepostorag_tpu.store import get_store
+
+            get_store().save()
+        return 0
 
     if args.local:
         from githubrepostorag_tpu.ingest.sources import LocalRepoReader

@@ -348,6 +348,61 @@ DISAGG_TRANSFER_SECONDS = Counter(
     ["replica"],
     registry=REGISTRY,
 )
+# --- Live device index (ingest/stream.py + retrieval/live_index.py +
+# retrieval/device_index.py): fragmentation gauges the background
+# compactor triggers on, watermark/lag gauges the apply loop publishes,
+# and the full-sync counter tests pin at zero on the churn hot path.
+INDEX_LIVE_ROWS = Gauge(
+    "rag_index_live_rows",
+    "Live (non-tombstoned) rows mirrored per device-index table",
+    ["table"],
+    registry=REGISTRY,
+)
+INDEX_HOLES = Gauge(
+    "rag_index_tombstoned_holes",
+    "Tombstoned hole rows awaiting compaction per device-index table",
+    ["table"],
+    registry=REGISTRY,
+)
+INDEX_CAPACITY = Gauge(
+    "rag_index_capacity_rows",
+    "Allocated capacity-bucket rows per device-index table",
+    ["table"],
+    registry=REGISTRY,
+)
+INDEX_COMPACTIONS = Counter(
+    "rag_index_compactions_total",
+    "In-place hole-reclaim compactions per device-index table "
+    "(warmed gather repack, same capacity bucket)",
+    ["table"],
+    registry=REGISTRY,
+)
+INDEX_FULL_SYNCS = Counter(
+    "rag_index_full_syncs_total",
+    "Whole-table transpose re-puts of a device-index corpus (initial "
+    "seeding and capacity growth; must NOT happen on the churn hot path)",
+    ["table"],
+    registry=REGISTRY,
+)
+INDEX_WATERMARK = Gauge(
+    "rag_index_watermark",
+    "Mutation-stream watermark by scope: kind=appended is the producers' "
+    "log head, kind=applied is the seq the live index has absorbed",
+    ["scope", "kind"],
+    registry=REGISTRY,
+)
+INDEX_APPLY_LAG = Gauge(
+    "rag_index_apply_lag_ops",
+    "Appended-minus-applied mutation ops per scope (stream backlog)",
+    ["scope"],
+    registry=REGISTRY,
+)
+INDEX_OPS_APPLIED = Counter(
+    "rag_index_ops_applied_total",
+    "Mutation ops the live-index apply loop drained into the store",
+    ["table", "kind"],
+    registry=REGISTRY,
+)
 MOE_ASSIGNMENTS = Counter(
     "rag_moe_expert_assignments_total",
     "MoE router token->expert assignments offered (MOE_DROP_STATS=1)",

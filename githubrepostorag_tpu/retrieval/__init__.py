@@ -4,16 +4,36 @@ rag_worker/src/worker/services/graph_rag_retrievers.py)."""
 
 from githubrepostorag_tpu.retrieval.coalescer import RetrievalCoalescer
 from githubrepostorag_tpu.retrieval.device_index import DeviceIndexedStore
+from githubrepostorag_tpu.retrieval.live_index import (
+    LiveIndexApplier,
+    LiveIndexedStore,
+    get_live_applier,
+    live_index_payload,
+    register_live_applier,
+)
 from githubrepostorag_tpu.retrieval.retrievers import (
     RetrievedDoc,
     RetrieverFactory,
     ScopeRetriever,
 )
+from githubrepostorag_tpu.retrieval.snapshot import (
+    load_snapshot,
+    restore_replica,
+    save_snapshot,
+)
 
 __all__ = [
     "DeviceIndexedStore",
+    "LiveIndexApplier",
+    "LiveIndexedStore",
     "RetrievalCoalescer",
     "RetrievedDoc",
     "RetrieverFactory",
     "ScopeRetriever",
+    "get_live_applier",
+    "live_index_payload",
+    "load_snapshot",
+    "register_live_applier",
+    "restore_replica",
+    "save_snapshot",
 ]

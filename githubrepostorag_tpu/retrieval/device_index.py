@@ -32,7 +32,14 @@ from typing import Iterable, Mapping, Sequence
 
 import numpy as np
 
-from githubrepostorag_tpu.metrics import DEVICE_INDEX_SEARCHES
+from githubrepostorag_tpu.metrics import (
+    DEVICE_INDEX_SEARCHES,
+    INDEX_CAPACITY,
+    INDEX_COMPACTIONS,
+    INDEX_FULL_SYNCS,
+    INDEX_HOLES,
+    INDEX_LIVE_ROWS,
+)
 from githubrepostorag_tpu.store.base import (
     SHREDDED_KEYS,
     Doc,
@@ -57,7 +64,8 @@ class _DeviceTable:
     row; deletes leave an invalid hole (a re-insert then appends, exactly
     like a dict re-insert moves to the end)."""
 
-    def __init__(self, dim: int, capacity: int) -> None:
+    def __init__(self, name: str, dim: int, capacity: int) -> None:
+        self.name = name
         self.dim = dim
         self.capacity = capacity
         self.ids: list[str] = []          # row -> doc_id ("" = hole)
@@ -69,6 +77,8 @@ class _DeviceTable:
         self.corpus_dev = None            # lazily synced jax array
         self.dirty_rows: set[int] = set()
         self.full_sync = True
+        self.compactions = 0              # in-place hole reclaims
+        self.full_syncs = 0               # whole-table transpose re-puts
 
 
 class DeviceIndexedStore(VectorStore):
@@ -102,7 +112,7 @@ class DeviceIndexedStore(VectorStore):
         self._tables: dict[str, _DeviceTable] = {}
         self._lock = threading.RLock()
         self._search_jit = self._build_search()
-        self._update_jit = None  # built lazily (first incremental sync)
+        self._update_jit, self._repack_jit = self._build_mutation()
         self._seed_from_inner()
 
     # ------------------------------------------------------------ programs
@@ -156,9 +166,43 @@ class DeviceIndexedStore(VectorStore):
 
         return jax.jit(sharded, static_argnames=("k",))
 
+    def _build_mutation(self):
+        """The two mutation programs: the bucketed row-scatter ``_sync``
+        dispatches for dirty rows, and the compaction gather that repacks
+        live columns to the front of the SAME capacity bucket.  Both
+        donate the corpus (in-place buffer reuse) and both are warmed by
+        ``warmup()`` over the scatter-bucket ladder, so sustained
+        mutation traffic and background compaction compile nothing live."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        update = jax.jit(
+            lambda c, i, v: c.at[:, i].set(v, mode="drop"),
+            donate_argnums=(0,),
+        )
+        # OOB src (== capacity) fills 0 — exactly the hole columns past
+        # the live-row prefix after a repack
+        kw = {}
+        sh = self._sharding(P(None, "dp"))
+        if sh is not None:
+            kw["out_shardings"] = sh
+        repack = jax.jit(
+            lambda c, s: jnp.take(c, s, axis=1, mode="fill", fill_value=0.0),
+            donate_argnums=(0,),
+            **kw,
+        )
+        return update, repack
+
     def search_program_cache_size(self) -> int:
         """Compiled search-program count (the warmup-contract observable)."""
         return self._search_jit._cache_size()
+
+    def mutation_program_cache_size(self) -> int:
+        """Compiled mutation-program count: the dirty-row scatter ladder
+        plus the compaction repack gather (the live-mutation observable —
+        compile_guard pins its delta at zero under churn)."""
+        return self._update_jit._cache_size() + self._repack_jit._cache_size()
 
     # ------------------------------------------------------------ mirror
 
@@ -177,9 +221,23 @@ class DeviceIndexedStore(VectorStore):
     def _table_for(self, name: str, dim: int) -> _DeviceTable:
         t = self._tables.get(name)
         if t is None:
-            t = _DeviceTable(dim, self._capacity_for(1))
+            t = _DeviceTable(name, dim, self._capacity_for(1))
             self._tables[name] = t
         return t
+
+    def reserve(self, table: str, capacity: int, dim: int | None = None) -> None:
+        """Pre-size a table's capacity bucket (snapshot restore, bench
+        setup) so a known-size corpus doesn't re-grow through every
+        intermediate bucket while it streams in."""
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None:
+                if dim is None:
+                    raise ValueError("reserve() on a new table needs dim")
+                t = _DeviceTable(table, dim, self._capacity_for(capacity))
+                self._tables[table] = t
+            elif self._capacity_for(capacity) > t.capacity:
+                self._grow(t, capacity)
 
     @staticmethod
     def _meta_entries(metadata: Mapping[str, str]) -> list[tuple[str, str]]:
@@ -225,6 +283,62 @@ class DeviceIndexedStore(VectorStore):
                        if r in old_row_of}
         t.corpus_dev, t.dirty_rows, t.full_sync = None, set(), True
 
+    def _compact_table(self, t: _DeviceTable) -> dict:
+        """Reclaim tombstoned holes IN PLACE: repack live rows to the
+        front of the SAME capacity bucket.  Relative live-row order is
+        preserved, so memory-store tie order survives; the device side is
+        one warmed ``_repack_jit`` gather (plus a warmed dirty-row
+        scatter to land pending writes first) — never the full-transpose
+        re-put ``_grow`` pays.  Caller holds the lock."""
+        holes = len(t.ids) - len(t.rows)
+        if holes <= 0:
+            return {"table": t.name, "reclaimed": 0, "live_rows": len(t.rows)}
+        live = sorted(t.rows.items(), key=lambda p: p[1])  # (id, row) by row
+        if t.corpus_dev is not None and not t.full_sync:
+            corpus = self._sync(t)  # land dirty rows via the warmed scatter
+            src = np.full(t.capacity, t.capacity, dtype=np.int32)  # OOB -> 0
+            src[: len(live)] = [old for _, old in live]
+            t.corpus_dev = self._repack_jit(corpus, src)
+        host = np.zeros_like(t.host)
+        valid = np.zeros_like(t.valid)
+        ids: list[str] = []
+        rows: dict[str, int] = {}
+        old_row_of = {old: new for new, (_, old) in enumerate(live)}
+        for new, (rid, old) in enumerate(live):
+            host[new] = t.host[old]
+            valid[new] = t.valid[old]
+            ids.append(rid)
+            rows[rid] = new
+        t.host, t.valid, t.ids, t.rows = host, valid, ids, rows
+        t.meta_rows = {
+            kv: {old_row_of[r] for r in rs if r in old_row_of}
+            for kv, rs in t.meta_rows.items()
+        }
+        t.meta_rows = {kv: rs for kv, rs in t.meta_rows.items() if rs}
+        t.meta_docs = {old_row_of[r]: md for r, md in t.meta_docs.items()
+                       if r in old_row_of}
+        t.dirty_rows = set()  # the repacked device copy mirrors host exactly
+        t.compactions += 1
+        INDEX_COMPACTIONS.labels(table=t.name).inc()
+        self._publish_gauges(t)
+        logger.info("device index %s: compacted %d holes (%d live / %d cap)",
+                    t.name, holes, len(rows), t.capacity)
+        return {"table": t.name, "reclaimed": holes, "live_rows": len(rows)}
+
+    def compact(self, table: str | None = None) -> list[dict]:
+        """Reclaim tombstoned holes (all tables, or one).  Returns one
+        report per table that actually had holes; the background
+        compactor (retrieval/live_index.py) calls this off its trigger
+        thresholds, operators can call it via the store handle."""
+        with self._lock:
+            names = [table] if table is not None else sorted(self._tables)
+            out = []
+            for name in names:
+                t = self._tables.get(name)
+                if t is not None and len(t.ids) - len(t.rows) > 0:
+                    out.append(self._compact_table(t))
+            return out
+
     def _mirror_upsert(self, table: str, docs: Sequence[Doc]) -> None:
         with self._lock:
             dims = [np.asarray(d.vector).size for d in docs if d.vector is not None]
@@ -250,7 +364,13 @@ class DeviceIndexedStore(VectorStore):
                     continue
                 if row is None:
                     if len(t.ids) >= t.capacity:
-                        self._grow(t, len(t.ids) + 1)
+                        if len(t.rows) < len(t.ids):
+                            # tombstoned holes exist: reclaim them in
+                            # place instead of growing — delete/re-upsert
+                            # churn stays inside one capacity bucket
+                            self._compact_table(t)
+                        if len(t.ids) >= t.capacity:
+                            self._grow(t, len(t.ids) + 1)
                     row = len(t.ids)
                     t.ids.append(doc.doc_id)
                     t.rows[doc.doc_id] = row
@@ -266,6 +386,12 @@ class DeviceIndexedStore(VectorStore):
                 t.dirty_rows.add(row)
                 self._index_row(t, row, doc.metadata)
                 t.meta_docs[row] = dict(doc.metadata)
+            self._publish_gauges(t)
+
+    def _publish_gauges(self, t: _DeviceTable) -> None:
+        INDEX_LIVE_ROWS.labels(table=t.name).set(len(t.rows))
+        INDEX_HOLES.labels(table=t.name).set(len(t.ids) - len(t.rows))
+        INDEX_CAPACITY.labels(table=t.name).set(t.capacity)
 
     def _row_metadata(self, t: _DeviceTable, row: int) -> Mapping[str, str]:
         return t.meta_docs.get(row, {})
@@ -285,6 +411,7 @@ class DeviceIndexedStore(VectorStore):
                 t.valid[row] = False
                 t.host[row] = 0.0
                 t.dirty_rows.add(row)
+            self._publish_gauges(t)
 
     # ------------------------------------------------------------ device sync
 
@@ -307,6 +434,8 @@ class DeviceIndexedStore(VectorStore):
             arr = jnp.asarray(np.ascontiguousarray(t.host.T))
             t.corpus_dev = jax.device_put(arr, sh) if sh else jax.device_put(arr)
             t.dirty_rows, t.full_sync = set(), False
+            t.full_syncs += 1
+            INDEX_FULL_SYNCS.labels(table=t.name).inc()
         elif t.dirty_rows:
             rows = sorted(t.dirty_rows)
             ub = next_bucket(len(rows), t.capacity, minimum=16)
@@ -314,11 +443,6 @@ class DeviceIndexedStore(VectorStore):
             idx[: len(rows)] = rows
             vals = np.zeros((t.dim, ub), dtype=np.float32)
             vals[:, : len(rows)] = t.host[rows].T
-            if self._update_jit is None:
-                self._update_jit = jax.jit(
-                    lambda c, i, v: c.at[:, i].set(v, mode="drop"),
-                    donate_argnums=(0,),
-                )
             t.corpus_dev = self._update_jit(t.corpus_dev, idx, vals)
             t.dirty_rows = set()
         return t.corpus_dev
@@ -349,7 +473,11 @@ class DeviceIndexedStore(VectorStore):
     def warmup(self, tables: Sequence[str] | None = None) -> int:
         """Compile the full live bucket set: every power-of-two query
         bucket up to ``max_wave`` against each table's current capacity
-        bucket.  Returns the number of compiled programs afterwards."""
+        bucket, plus the MUTATION ladder — every dirty-row scatter bucket
+        ``_sync`` can dispatch (16..capacity) and the compaction repack
+        gather — so live query traffic, streamed mutations, and
+        background compaction all hit precompiled shapes.  Returns the
+        number of compiled search programs afterwards."""
         with self._lock:
             names = list(tables) if tables is not None else sorted(self._tables)
             for name in names:
@@ -365,7 +493,25 @@ class DeviceIndexedStore(VectorStore):
                     if qb >= self.max_wave:
                         break
                     qb *= 2
+                self._warm_mutation(t)
         return self.search_program_cache_size()
+
+    def _warm_mutation(self, t: _DeviceTable) -> None:
+        """Run every mutation shape once as an identity op: all-OOB
+        scatter indices drop every update, and an arange repack src
+        gathers each column onto itself.  Both programs donate the
+        corpus, so the returned (unchanged) array replaces it."""
+        ub = 16  # _sync's minimum scatter bucket
+        while True:
+            ub = min(ub, t.capacity)
+            idx = np.full(ub, t.capacity, dtype=np.int32)   # all OOB
+            vals = np.zeros((t.dim, ub), dtype=np.float32)
+            t.corpus_dev = self._update_jit(t.corpus_dev, idx, vals)
+            if ub >= t.capacity:
+                break
+            ub *= 2
+        src = np.arange(t.capacity, dtype=np.int32)         # identity gather
+        t.corpus_dev = self._repack_jit(t.corpus_dev, src)
 
     def _dispatch(self, t: _DeviceTable, corpus, queries: np.ndarray,
                   mask: np.ndarray, k: int):
@@ -491,10 +637,21 @@ class DeviceIndexedStore(VectorStore):
 
     def health(self) -> dict:
         h = self.inner.health()
-        h["device_index"] = {
-            name: {"capacity": t.capacity, "rows": len(t.rows)}
-            for name, t in self._tables.items()
-        }
+        dev: dict[str, dict] = {}
+        with self._lock:
+            for name, t in self._tables.items():
+                holes = len(t.ids) - len(t.rows)
+                dev[name] = {
+                    "capacity": t.capacity,
+                    "rows": len(t.rows),          # pre-PR13 key, kept
+                    "live_rows": len(t.rows),
+                    "holes": holes,
+                    "dirty_rows": len(t.dirty_rows),
+                    "compactions": t.compactions,
+                    "full_syncs": t.full_syncs,
+                }
+                self._publish_gauges(t)
+        h["device_index"] = dev
         return h
 
     def save(self) -> None:

@@ -70,6 +70,7 @@ class OpenAIServer:
         app.router.add_get("/health", self.health)
         app.router.add_get("/debug/slo", self.debug_slo)
         app.router.add_get("/debug/fleet", self.debug_fleet)
+        app.router.add_get("/debug/index", self.debug_index)
         app.router.add_post("/debug/fleet/drain", self.fleet_drain)
         app.router.add_post("/debug/fleet/activate", self.fleet_activate)
         return app
@@ -107,6 +108,11 @@ class OpenAIServer:
         from githubrepostorag_tpu.obs.slo import get_slo_plane
 
         return web.json_response(get_slo_plane().fleet_payload())
+
+    async def debug_index(self, request: web.Request) -> web.Response:
+        from githubrepostorag_tpu.retrieval.live_index import live_index_payload
+
+        return web.json_response(live_index_payload())
 
     async def _fleet_lifecycle(self, request: web.Request, verb: str) -> web.Response:
         """Shared body for POST /debug/fleet/{drain,activate}: duck-typed on

@@ -17,6 +17,12 @@ def get_store() -> VectorStore:
 
 def reset_store() -> None:
     global _store
+    applier = getattr(_store, "applier", None)
+    if applier is not None:  # live-index front: stop the drain thread
+        from githubrepostorag_tpu.retrieval.live_index import register_live_applier
+
+        applier.stop()
+        register_live_applier(None)
     _store = None
 
 
@@ -81,4 +87,34 @@ def _build() -> VectorStore:
             k_bucket=s.device_index_k_bucket,
             max_wave=s.retrieval_max_wave,
         )
+    if s.live_index.strip().lower() in {"on", "1", "true", "yes"}:
+        store = _wrap_live_index(store, s)
     return store
+
+
+def _wrap_live_index(store: VectorStore, s) -> VectorStore:
+    """LIVE_INDEX=on: writes append to the watermarked mutation log, a
+    daemon apply loop drains them into the wrapped store while queries
+    run, and the applier registers for /debug/index."""
+    import os
+
+    from githubrepostorag_tpu.ingest.stream import MutationLog
+    from githubrepostorag_tpu.retrieval.live_index import (
+        LiveIndexApplier,
+        LiveIndexedStore,
+        register_live_applier,
+    )
+
+    log_path = s.live_index_log_path or (
+        os.path.join(s.data_dir, "mutation_log.jsonl") if s.data_dir else "")
+    log = MutationLog(path=log_path or None)
+    applier = LiveIndexApplier(
+        log,
+        store,
+        apply_batch=s.live_index_apply_batch,
+        compact_interval_s=s.index_compact_interval_s,
+        compact_min_holes=s.index_compact_min_holes,
+        compact_max_hole_fraction=s.index_compact_max_hole_fraction,
+    ).start()
+    register_live_applier(applier)
+    return LiveIndexedStore(store, log, applier)

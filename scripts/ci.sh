@@ -57,6 +57,14 @@ echo "== disaggregated-serving A/B (CPU-tiny) =="
 # the kv_transfer accounting + wire seconds inside the 2% obs budget.
 BENCH_ONLY=disagg JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
 
+echo "== live-index streaming A/B (CPU-tiny) =="
+# idle vs under-streamed-re-index query p95 on the same warmed device
+# index: bench_liveindex_pair asserts doc-id parity before timing, live
+# p95 <= 1.5x idle, zero live XLA compiles on both the search and
+# mutation program caches, no whole-table transpose re-put (full_syncs),
+# and watermark-gauge publishing inside the 2% obs budget.
+BENCH_ONLY=liveindex JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -1,0 +1,3 @@
+"""SHP001 negative (compaction flavor): the same survivor-count flow, but
+the repack vector is padded to the capacity bucket before it reaches the
+shape position — one program per capacity rung, not per survivor count."""

@@ -1,0 +1,13 @@
+import jax.numpy as jnp
+
+
+def next_bucket(n, cap, minimum=16):
+    b = minimum
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+def repack_src(rows):
+    rows = next_bucket(rows, 256)
+    return jnp.zeros((rows,), jnp.int32)

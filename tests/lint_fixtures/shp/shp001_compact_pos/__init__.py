@@ -1,0 +1,4 @@
+"""SHP001 positive (compaction flavor): the live-row count surviving a
+tombstone sweep is len() of request-sized data; sizing the repack gather
+vector by it compiles a fresh XLA program for every distinct survivor
+count.  The source is in compactor.py, the sink in repack.py."""

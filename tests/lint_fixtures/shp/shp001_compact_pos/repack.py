@@ -1,0 +1,7 @@
+import jax.numpy as jnp
+
+
+def repack_src(rows):
+    # rows is tainted via the caller in compactor.py; a gather source
+    # vector sized by the live-row count recompiles per survivor count
+    return jnp.zeros((rows,), jnp.int32)

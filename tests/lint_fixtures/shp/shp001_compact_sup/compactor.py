@@ -1,0 +1,6 @@
+from shp001_compact_sup.repack import repack_src
+
+
+def sweep(docs):
+    live = len(docs)
+    return repack_src(live)

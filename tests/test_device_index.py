@@ -191,6 +191,47 @@ def test_warmup_compiles_exact_bucket_set_and_traffic_adds_zero():
         dev.search("t", rng.normal(size=DIM).astype(np.float32), 3)
 
 
+def test_delete_reupsert_churn_reuses_holes_without_growing():
+    """PR-13 hole reuse: at capacity, delete->re-upsert churn compacts
+    tombstoned holes in place instead of growing the bucket — capacity
+    pins, full_syncs stays put, zero new programs (the repack gather and
+    the dirty-row scatter are both warmed), and score/tie-order parity
+    holds through every row remap."""
+    rng = np.random.default_rng(29)
+    inner = MemoryVectorStore()
+    dev = DeviceIndexedStore(inner, k_bucket=16, max_wave=8)
+    dev.upsert("t", _mk_docs(rng, 50))
+    dev.warmup()
+    h0 = dev.health()["device_index"]["t"]
+    assert h0["capacity"] == 64
+    with compile_guard(dev.search_program_cache_size, label="churn search"), \
+         compile_guard(dev.mutation_program_cache_size,
+                       label="churn mutation"):
+        for cycle in range(40):
+            did = f"d{int(rng.integers(50)):04d}"
+            dev.delete("t", [did])
+            dev.upsert("t", [Doc(did, f"cycle {cycle}", {"repo": "repo0"},
+                                 rng.normal(size=DIM).astype(np.float32))])
+            if cycle % 7 == 0:
+                q = rng.normal(size=DIM).astype(np.float32)
+                host, devh = inner.search("t", q, 10), dev.search("t", q, 10)
+                assert _ids(host) == _ids(devh)
+                assert np.allclose(_scores(host), _scores(devh), atol=1e-5)
+    h1 = dev.health()["device_index"]["t"]
+    assert h1["capacity"] == 64          # holes reused, bucket never grew
+    assert h1["compactions"] > 0
+    assert h1["full_syncs"] == h0["full_syncs"]  # no whole-table re-put
+    # operator-facing compact() drains the remaining holes completely
+    dev.compact("t")
+    assert dev.health()["device_index"]["t"]["holes"] == 0
+    # ties still break by insertion order after rows were remapped
+    v = rng.normal(size=DIM).astype(np.float32)
+    dev.upsert("t", [Doc(f"tie{i}", "same", {}, v.copy()) for i in range(3)])
+    expect = ["tie0", "tie1", "tie2"]
+    assert _ids(inner.search("t", v, 3)) == expect
+    assert _ids(dev.search("t", v, 3)) == expect
+
+
 def test_device_path_counted():
     rng = np.random.default_rng(23)
     inner = MemoryVectorStore()

@@ -1,0 +1,542 @@
+"""Streaming ingest -> live device index (PR 13): mutation-log ordering,
+watermarks and durable replay; the apply loop draining into the device
+index while queries run; background hole-reclaim compaction; and
+versioned snapshot/restore.
+
+The acceptance bars from the ISSUE are pinned here:
+
+* concurrent apply-vs-query: every result a query thread observes equals
+  some exact PREFIX of the mutation stream (watermark-bounded
+  consistency), with ZERO live XLA compiles under sustained mutation —
+  ``compile_guard`` over both the search and mutation program counters;
+* churn: tombstoned holes return to ~0 via in-place compaction with the
+  ``full_syncs`` counter unmoved (no whole-table re-put on the hot path)
+  and the capacity bucket never growing;
+* snapshot -> restore: the replica is score- and tie-order-IDENTICAL
+  (exact float equality, not just allclose) and replays only the log
+  suffix past the snapshot watermark.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from githubrepostorag_tpu.ingest.stream import (
+    DELETE,
+    UPSERT,
+    MutationLog,
+    StreamSink,
+    apply_ops,
+    watch_local,
+)
+from githubrepostorag_tpu.metrics import (
+    INDEX_FULL_SYNCS,
+    INDEX_HOLES,
+    INDEX_WATERMARK,
+    counter_value,
+)
+from githubrepostorag_tpu.retrieval import (
+    DeviceIndexedStore,
+    LiveIndexApplier,
+    LiveIndexedStore,
+    get_live_applier,
+    live_index_payload,
+    register_live_applier,
+    load_snapshot,
+    restore_replica,
+    save_snapshot,
+)
+from githubrepostorag_tpu.retrieval.live_index import TOTAL_SCOPE
+from githubrepostorag_tpu.store.base import Doc
+from githubrepostorag_tpu.store.memory import MemoryVectorStore
+from tests.helpers.compile_guard import compile_guard
+
+DIM = 16
+
+
+def _mk_docs(rng, n, prefix="d", dim=DIM):
+    return [
+        Doc(f"{prefix}{i:04d}", f"text {i}",
+            {"namespace": "default", "repo": f"repo{i % 3}"},
+            rng.normal(size=dim).astype(np.float32))
+        for i in range(n)
+    ]
+
+
+def _ids(hits):
+    return [h.doc.doc_id for h in hits]
+
+
+def _scores(hits):
+    return [h.score for h in hits]
+
+
+# ------------------------------------------------------------- mutation log
+
+
+def test_log_assigns_one_total_order_and_per_table_watermarks():
+    log = MutationLog()
+    rng = np.random.default_rng(0)
+    s1 = log.append_upsert("a", _mk_docs(rng, 3, prefix="a"))
+    s2 = log.append_upsert("b", _mk_docs(rng, 2, prefix="b"))
+    s3 = log.append_delete("a", ["a0000"])
+    # ONE total order across tables: seqs are strictly monotonic
+    assert (s1, s2, s3) == (3, 5, 6)
+    wm = log.watermark()
+    assert wm["seq"] == 6
+    assert wm["tables"] == {"a": 6, "b": 5}
+    ops = log.read_since(0)
+    assert [op.seq for op in ops] == [1, 2, 3, 4, 5, 6]
+    assert [op.kind for op in ops] == [UPSERT] * 5 + [DELETE]
+    assert [op.seq for op in log.read_since(4)] == [5, 6]
+    assert [op.seq for op in log.read_since(2, limit=2)] == [3, 4]
+    assert log.read_since(6) == []
+
+
+def test_log_durable_replay_trim_and_bit_exact_vectors(tmp_path):
+    path = str(tmp_path / "wal" / "mutation_log.jsonl")
+    rng = np.random.default_rng(1)
+    docs = _mk_docs(rng, 4)
+    log = MutationLog(path=path)
+    log.append_upsert("t", docs)
+    log.append_delete("t", [docs[0].doc_id])
+    wm = log.watermark()
+    log.close()
+    # a restarted replica replays the file and lands on the same watermark
+    replayed = MutationLog(path=path)
+    assert replayed.watermark() == wm
+    ops = replayed.read_since(0)
+    assert len(ops) == 5
+    for op, d in zip(ops[:4], docs):
+        # float32 -> repr -> float32 must round-trip BIT-exactly, or
+        # replayed scores drift from the original's
+        assert op.vector.dtype == np.float32
+        np.testing.assert_array_equal(
+            op.vector, np.asarray(d.vector, dtype=np.float32))
+    # trim drops the memory tail; older cursors fall back to the file
+    assert replayed.trim(3) == 3
+    assert [op.seq for op in replayed.read_since(0)] == [1, 2, 3, 4, 5]
+    assert [op.seq for op in replayed.read_since(3)] == [4, 5]
+    replayed.close()
+    # memory-only logs refuse to trim: the tail is their only replay source
+    mem = MutationLog()
+    mem.append_upsert("t", docs[:1])
+    assert mem.trim(1) == 0
+    assert len(mem.read_since(0)) == 1
+
+
+class _RecordingStore(MemoryVectorStore):
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def upsert(self, table, docs):
+        self.calls.append(("upsert", table, len(docs)))
+        return super().upsert(table, docs)
+
+    def delete(self, table, doc_ids):
+        doc_ids = list(doc_ids)
+        self.calls.append(("delete", table, len(doc_ids)))
+        return super().delete(table, doc_ids)
+
+
+def test_stream_sink_routes_writes_and_apply_ops_batches_runs():
+    rng = np.random.default_rng(2)
+    log = MutationLog()
+    sink = StreamSink(log)
+    docs_t, docs_u = _mk_docs(rng, 3), _mk_docs(rng, 2, prefix="u")
+    assert sink.upsert("t", docs_t) == 3
+    assert sink.upsert("u", docs_u) == 2
+    assert sink.delete("t", [d.doc_id for d in docs_t[:2]]) == 2
+    sink.save()  # durable already; must be a no-op, not an error
+    # apply batches each maximal same-(kind, table) run into ONE store call
+    rec = _RecordingStore()
+    apply_ops(rec, log.read_since(0))
+    assert rec.calls == [("upsert", "t", 3), ("upsert", "u", 2),
+                         ("delete", "t", 2)]
+    direct = MemoryVectorStore()
+    direct.upsert("t", docs_t)
+    direct.upsert("u", docs_u)
+    direct.delete("t", [d.doc_id for d in docs_t[:2]])
+    for table in ("t", "u"):
+        assert rec.count(table) == direct.count(table)
+        q = rng.normal(size=DIM).astype(np.float32)
+        assert _ids(rec.search(table, q, 5)) == _ids(direct.search(table, q, 5))
+
+
+# ------------------------------------------------------------------ applier
+
+
+def test_applier_thread_drains_and_publishes_watermarks():
+    log = MutationLog()
+    store = MemoryVectorStore()
+    # long idle interval: shutdown latency below proves poke() releases
+    # the park point instead of waiting the interval out
+    applier = LiveIndexApplier(log, store, apply_batch=4,
+                               compact_interval_s=30.0).start()
+    try:
+        rng = np.random.default_rng(3)
+        log.append_upsert("t", _mk_docs(rng, 10))
+        assert applier.flush(timeout=10)
+        assert store.count("t") == 10
+        assert applier.applied_seq() == log.watermark()["seq"] == 10
+        p = applier.payload()
+        assert p["enabled"] is True
+        assert p["lag_ops"] == 0 and p["ops_applied"] == 10
+        assert p["watermark"]["scopes"]["t"] == {
+            "appended": 10, "applied": 10, "lag": 0}
+        assert counter_value(
+            INDEX_WATERMARK, scope=TOTAL_SCOPE, kind="applied") == 10
+        assert counter_value(
+            INDEX_WATERMARK, scope="t", kind="appended") == 10
+        t0 = time.monotonic()
+    finally:
+        applier.stop()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_applier_start_seq_skips_the_pre_watermark_prefix():
+    log = MutationLog()
+    rng = np.random.default_rng(4)
+    log.append_upsert("t", _mk_docs(rng, 5))
+    store = MemoryVectorStore()
+    applier = LiveIndexApplier(log, store, start_seq=3)
+    assert applier.drain() == 2  # ops 4 and 5 only
+    assert store.count("t") == 2
+
+
+def test_concurrent_queries_see_only_stream_prefixes_with_zero_compiles():
+    """Randomized interleavings: producer appends churn ops while two
+    query threads hammer the device index.  Every observed result must
+    equal some exact op-prefix of the stream (the store lock serializes
+    each apply run against searches), and the whole run — applies,
+    background compactions, queries — adds ZERO XLA programs."""
+    rng = np.random.default_rng(5)
+    seed_docs = _mk_docs(rng, 40)
+    # churn plan over the SEED id set only (capacity bucket never grows):
+    # vector updates, deletes, and re-upserts of deleted ids
+    live = {d.doc_id for d in seed_docs}
+    dead: set[str] = set()
+    plan: list[tuple[str, str, np.ndarray | None]] = []
+    for step in range(60):
+        roll = rng.random()
+        if roll < 0.3 and len(live) > 30:
+            did = sorted(live)[int(rng.integers(len(live)))]
+            live.discard(did)
+            dead.add(did)
+            plan.append((DELETE, did, None))
+        else:
+            if dead and roll < 0.6:
+                did = sorted(dead)[int(rng.integers(len(dead)))]
+                dead.discard(did)
+            else:
+                did = sorted(live)[int(rng.integers(len(live)))]
+            live.add(did)
+            plan.append((UPSERT, did,
+                         rng.normal(size=DIM).astype(np.float32)))
+
+    inner = MemoryVectorStore()
+    dev = DeviceIndexedStore(inner, k_bucket=16, max_wave=8)
+    dev.upsert("t", seed_docs)
+    dev.warmup()
+
+    # reference prefix states: top-k ids after every op, host-store truth
+    queries = [rng.normal(size=DIM).astype(np.float32) for _ in range(3)]
+    ref = MemoryVectorStore()
+    ref.upsert("t", seed_docs)
+    allowed = [{tuple(_ids(ref.search("t", q, 5)))} for q in queries]
+    for kind, did, vec in plan:
+        if kind == DELETE:
+            ref.delete("t", [did])
+        else:
+            ref.upsert("t", [Doc(did, f"u {did}", {"repo": "repo0"}, vec)])
+        for i, q in enumerate(queries):
+            allowed[i].add(tuple(_ids(ref.search("t", q, 5))))
+
+    log = MutationLog()
+    applier = LiveIndexApplier(log, dev, apply_batch=6,
+                               compact_interval_s=0.05,
+                               compact_min_holes=8,
+                               compact_max_hole_fraction=0.2)
+    observed: list[set[tuple]] = [set() for _ in queries]
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def query_loop():
+        n = 0
+        try:
+            while not stop.is_set():
+                i = n % len(queries)
+                observed[i].add(tuple(_ids(dev.search("t", queries[i], 5))))
+                n += 1
+        except BaseException as exc:  # noqa: BLE001 - surface in main thread
+            errors.append(exc)
+
+    with compile_guard(dev.search_program_cache_size,
+                       label="live apply-vs-query search"), \
+         compile_guard(dev.mutation_program_cache_size,
+                       label="live apply-vs-query mutation"):
+        applier.start()
+        try:
+            threads = [threading.Thread(target=query_loop) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for kind, did, vec in plan:  # randomized producer pacing
+                if kind == DELETE:
+                    log.append_delete("t", [did])
+                else:
+                    log.append_upsert(
+                        "t", [Doc(did, f"u {did}", {"repo": "repo0"}, vec)])
+                if rng.random() < 0.3:
+                    time.sleep(0.001)
+            assert applier.flush(timeout=30)
+            stop.set()
+            for t in threads:
+                t.join()
+        finally:
+            stop.set()
+            applier.stop()
+    assert not errors, errors
+    for i, q in enumerate(queries):
+        assert observed[i], "query thread never completed a search"
+        rogue = observed[i] - allowed[i]
+        assert not rogue, f"query {i} observed non-prefix states: {rogue}"
+        # fully-applied stream: device equals the host reference exactly
+        assert _ids(dev.search("t", q, 5)) == _ids(ref.search("t", q, 5))
+        np.testing.assert_allclose(
+            _scores(dev.search("t", q, 5)), _scores(ref.search("t", q, 5)),
+            atol=1e-5)
+
+
+# --------------------------------------------------------------- compaction
+
+
+def test_churn_reclaims_holes_in_place_without_full_sync():
+    rng = np.random.default_rng(6)
+    inner = MemoryVectorStore()
+    dev = DeviceIndexedStore(inner, k_bucket=16, max_wave=8)
+    docs = _mk_docs(rng, 50)
+    dev.upsert("t", docs)
+    dev.warmup()
+    h0 = dev.health()["device_index"]["t"]
+    full_syncs0 = h0["full_syncs"]
+    metric_full0 = counter_value(INDEX_FULL_SYNCS, table="t")
+    log = MutationLog()
+    applier = LiveIndexApplier(log, dev, apply_batch=7, compact_min_holes=4,
+                               compact_max_hole_fraction=0.2)
+    ref = MemoryVectorStore()
+    ref.upsert("t", docs)
+    q = rng.normal(size=DIM).astype(np.float32)
+    with compile_guard(dev.search_program_cache_size, label="churn search"), \
+         compile_guard(dev.mutation_program_cache_size,
+                       label="churn mutation"):
+        for cycle in range(30):
+            did = f"d{int(rng.integers(50)):04d}"
+            log.append_delete("t", [did])
+            doc = Doc(did, f"cycle {cycle}", {"repo": f"repo{cycle % 3}"},
+                      rng.normal(size=DIM).astype(np.float32))
+            log.append_upsert("t", [doc])
+            ref.delete("t", [did])
+            ref.upsert("t", [doc])
+            applier.drain()
+            if cycle % 5 == 0:
+                assert _ids(dev.search("t", q, 8)) == _ids(ref.search("t", q, 8))
+    h1 = dev.health()["device_index"]["t"]
+    assert h1["capacity"] == h0["capacity"] == 64  # churn never grew the bucket
+    assert h1["holes"] < applier.compact_min_holes  # gauge back to ~0
+    assert h1["compactions"] > 0
+    # counter-asserted: NO whole-table re-put on the hot path
+    assert h1["full_syncs"] == full_syncs0
+    assert counter_value(INDEX_FULL_SYNCS, table="t") == metric_full0
+    assert counter_value(INDEX_HOLES, table="t") == h1["holes"]
+    assert applier.payload()["compaction"]["reclaimed_rows"] > 0
+    # score and tie-order parity survived row remapping
+    for _ in range(3):
+        qq = rng.normal(size=DIM).astype(np.float32)
+        assert _ids(dev.search("t", qq, 10)) == _ids(ref.search("t", qq, 10))
+        np.testing.assert_allclose(
+            _scores(dev.search("t", qq, 10)), _scores(ref.search("t", qq, 10)),
+            atol=1e-5)
+
+
+# ------------------------------------------------------- snapshot / restore
+
+
+def test_snapshot_restore_identical_with_suffix_only_replay(tmp_path):
+    rng = np.random.default_rng(7)
+    log = MutationLog()
+    inner = MemoryVectorStore()
+    dev = DeviceIndexedStore(inner, k_bucket=16, max_wave=8)
+    applier = LiveIndexApplier(log, dev, apply_batch=16)
+    docs = _mk_docs(rng, 45)
+    v = rng.normal(size=DIM).astype(np.float32)
+    ties = [Doc(f"tie{i}", "same", {"repo": "repo0"}, v.copy())
+            for i in range(4)]
+    log.append_upsert("t", docs)
+    log.append_upsert("t", ties)
+    log.append_delete("t", ["d0004", "d0010"])
+    applier.drain()
+    dev.warmup()
+
+    snap = str(tmp_path / "snap")
+    manifest = save_snapshot(dev, snap, watermark=applier.applied_seq())
+    assert manifest["version"] == 1
+    assert manifest["watermark"]["seq"] == applier.applied_seq()
+    (entry,) = manifest["tables"]
+    assert entry["name"] == "t" and entry["count"] == 47  # 45 + 4 - 2
+    assert entry["capacity"] == 64 and entry["dim"] == DIM
+
+    # ops PAST the snapshot watermark — the only thing restore may replay
+    log.append_upsert("t", [Doc("d0004", "back", {"repo": "repo1"},
+                                rng.normal(size=DIM).astype(np.float32))])
+    log.append_delete("t", ["tie3"])
+    applier.drain()
+
+    replica = DeviceIndexedStore(MemoryVectorStore(), k_bucket=16, max_wave=8)
+    out = restore_replica(snap, replica, log=log)
+    assert out["replayed"] == 2  # the suffix, nothing earlier
+    assert replica.count("t") == dev.count("t")
+    # reserve() pre-sized the replica straight to the recorded bucket
+    assert (replica.health()["device_index"]["t"]["capacity"]
+            == dev.health()["device_index"]["t"]["capacity"])
+    queries = [rng.normal(size=DIM).astype(np.float32) for _ in range(4)] + [v]
+    for q in queries:
+        for flt in (None, {"repo": "repo0"}):
+            a = dev.search("t", q, 8, filter=flt)
+            b = replica.search("t", q, 8, filter=flt)
+            # identical raw bits in, identical program: scores must match
+            # EXACTLY, and ties (tie0..tie2) must break in the same order
+            assert _ids(a) == _ids(b)
+            assert _scores(a) == _scores(b)
+
+
+def test_snapshot_version_gate_refuses_mismatch(tmp_path):
+    store = MemoryVectorStore()
+    store.upsert("t", _mk_docs(np.random.default_rng(8), 3))
+    snap = str(tmp_path / "snap")
+    manifest = save_snapshot(store, snap, watermark=3)
+    assert manifest["watermark"] == {"seq": 3, "tables": {}}
+    mpath = os.path.join(snap, "manifest.json")
+    with open(mpath, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    doc["version"] = 99
+    with open(mpath, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    with pytest.raises(ValueError, match="version"):
+        load_snapshot(snap, MemoryVectorStore())
+
+
+# --------------------------------------------------- store front / registry
+
+
+def test_live_indexed_store_front_and_registry_payload():
+    log = MutationLog()
+    store = MemoryVectorStore()
+    applier = LiveIndexApplier(log, store)
+    front = LiveIndexedStore(store, log, applier)
+    rng = np.random.default_rng(9)
+    docs = _mk_docs(rng, 6)
+    assert front.upsert("t", docs) == 6  # producer returns immediately
+    assert front.count("t") == 0  # reads trail the log until the apply runs
+    applier.flush()  # threadless flush drains inline
+    assert front.count("t") == 6
+    assert front.tables() == ["t"]
+    q = rng.normal(size=DIM).astype(np.float32)
+    assert _ids(front.search("t", q, 3)) == _ids(store.search("t", q, 3))
+    assert front.delete("t", [docs[0].doc_id]) == 1
+    applier.flush()
+    assert front.get("t", docs[0].doc_id) is None
+    h = front.health()
+    assert h["live_index"]["enabled"] is True
+    assert h["live_index"]["lag_ops"] == 0
+    # /debug/index registry: explicit disabled marker without an applier
+    assert live_index_payload() == {"enabled": False}
+    register_live_applier(applier)
+    try:
+        assert get_live_applier() is applier
+        assert (live_index_payload()["watermark"]["applied"]
+                == applier.applied_seq())
+    finally:
+        register_live_applier(None)
+
+
+async def test_debug_index_endpoint_renders_registry_payload():
+    from githubrepostorag_tpu.api.app import RagApi
+    from githubrepostorag_tpu.serving.openai_api import OpenAIServer
+
+    # the handlers only consult the registry — no engine/bus wiring needed
+    server = OpenAIServer.__new__(OpenAIServer)
+    api = RagApi.__new__(RagApi)
+    for handler in (server.debug_index, api.debug_index):
+        assert json.loads((await handler(None)).body) == {"enabled": False}
+    applier = LiveIndexApplier(MutationLog(), MemoryVectorStore())
+    register_live_applier(applier)
+    try:
+        for handler in (server.debug_index, api.debug_index):
+            body = json.loads((await handler(None)).body)
+            assert body["enabled"] is True
+            assert "watermark" in body and "compaction" in body
+    finally:
+        register_live_applier(None)
+
+
+def test_factory_builds_live_front_when_enabled(monkeypatch, tmp_path):
+    from githubrepostorag_tpu.config import reload_settings
+    from githubrepostorag_tpu.store.factory import get_store, reset_store
+
+    monkeypatch.setenv("STORE_BACKEND", "memory")
+    monkeypatch.setenv("LIVE_INDEX", "on")
+    monkeypatch.setenv("LIVE_INDEX_LOG_PATH", str(tmp_path / "mlog.jsonl"))
+    reload_settings()
+    reset_store()
+    try:
+        store = get_store()
+        assert isinstance(store, LiveIndexedStore)
+        assert get_live_applier() is store.applier
+        rng = np.random.default_rng(10)
+        store.upsert("t", _mk_docs(rng, 4))
+        assert store.applier.flush(timeout=10)
+        assert store.count("t") == 4
+        assert (tmp_path / "mlog.jsonl").exists()  # producer writes durable
+        thread = store.applier._thread
+        assert thread is not None and thread.is_alive()
+        reset_store()  # must stop the drain thread and clear the registry
+        assert get_live_applier() is None
+        assert not thread.is_alive()
+    finally:
+        monkeypatch.delenv("STORE_BACKEND", raising=False)
+        monkeypatch.delenv("LIVE_INDEX", raising=False)
+        monkeypatch.delenv("LIVE_INDEX_LOG_PATH", raising=False)
+        reload_settings()
+        reset_store()
+
+
+# ------------------------------------------------------------------- watch
+
+
+def test_watch_local_fires_on_fingerprint_change(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    events = []
+
+    def on_change():
+        events.append(len(events))
+        if len(events) == 1:
+            # mutate the tree between polls: the next poll must fire
+            (tmp_path / "b.py").write_text("y = 2\n")
+        elif len(events) == 2:
+            # hidden files are not fingerprinted: no third fire
+            (tmp_path / ".hidden").write_text("z\n")
+
+    fired = watch_local(str(tmp_path), on_change, interval_s=0.01,
+                        max_polls=5)
+    assert fired == 2  # the initial index + the visible change
+    assert events == [0, 1]
+    # a pre-set stop event short-circuits before the first poll
+    ev = threading.Event()
+    ev.set()
+    assert watch_local(str(tmp_path), on_change, interval_s=0.01,
+                       stop=ev) == 0

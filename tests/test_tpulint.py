@@ -171,6 +171,35 @@ def test_shp001_message_carries_cross_module_taint_chain():
         assert ":" in step and "[" in step  # every step carries file:line
 
 
+# The live-index compactor extends the SHP001 alphabet: the repack gather
+# vector must be sized by the CAPACITY bucket, not by the live-row count
+# that survives a tombstone sweep (retrieval/device_index.py sizes the
+# source vector at t.capacity for exactly this reason — one repack program
+# per capacity rung, any survivor count).
+
+def test_shp001_compact_positive_catches_survivor_sized_repack():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_compact_pos"])
+    hits = [f for f in findings if f.rule == "SHP001" and not f.suppressed]
+    assert hits, "survivor-count-sized repack vector escaped the taint pass"
+    (hit,) = hits
+    assert "len(docs)" in hit.taint_chain[0]
+    assert "compactor.py" in hit.taint_chain[0]  # source module
+    assert "repack.py" in hit.taint_chain[-1]  # sink module
+
+
+def test_shp001_compact_negative_is_silent():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_compact_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_shp001_compact_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_compact_sup"])
+    hits = [f for f in findings if f.rule == "SHP001"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
 # ------------------------------------------------------- planted regressions
 # Mutation tests against the REAL tree: re-introduce the two classes of bug
 # the shapeflow pass exists to catch, and prove it catches them.
