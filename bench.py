@@ -1197,6 +1197,151 @@ def bench_kv_tier_pair(tag: str, *, waves=(48, 48, 32), prefix_len: int = 48,
             **{p: (out[p][0], out[p][1]) for p in out}}
 
 
+def bench_preempt_pair(tag: str, *, batch_n: int = 16, hot_n: int = 112,
+                       batch_tokens: int = 48, hot_tokens: int = 8,
+                       hot_per_step: int = 2, warm_steps: int = 2) -> dict:
+    """``preempt_conc128``: page-granularity preemption vs plain FIFO on
+    the SAME 128-request saturating schedule over identical tiered
+    engines.  16 batch requests land first and their 8-page footprints
+    fill the device pool exactly; 112 interactive requests then arrive 2
+    per step.  With ``preempt="off"`` the queue is FIFO — every
+    interactive arrival waits out the batch backlog.  With ``preempt="on"``
+    a protected arrival that cannot be admitted parks a batch victim's KV
+    to the host tier; the victim resumes later through claim/fault-in and
+    finishes token-identically with zero recomputed prompt tokens.
+
+    Asserts before reporting: both paths token-identical to each other
+    AND batch outputs identical to an unloaded reference, preemptions
+    actually fired and every victim resumed via fault-in with zero prompt
+    recompute (ledger counters), zero live-traffic XLA compiles, and
+    interactive TTFT p99 with preemption at or under 0.5x the
+    preemption-off path."""
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+    from githubrepostorag_tpu.obs.engine_profile import CompileWatchdog
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(13), dtype=jnp.float32)
+    # 64 pages x 8 tokens: a batch request spans 16+48=64 tokens = 8 pages,
+    # so 8 co-resident batch rows hold the ENTIRE device pool — every
+    # interactive arrival after that must either wait (off) or preempt (on)
+    geom = dict(max_num_seqs=12, num_pages=64, page_size=8, max_seq_len=64,
+                prefill_chunk=32, kv_dtype=jnp.float32, decode_burst=4,
+                prefix_caching=True, kv_tier="on", kv_host_pool_pages=256,
+                kv_migrate_burst=8)
+    rng = np.random.default_rng(37)
+    batch_prompts = [rng.integers(0, cfg.vocab_size, 16).tolist()
+                     for _ in range(batch_n)]
+    hot_prompts = [rng.integers(0, cfg.vocab_size, 16).tolist()
+                   for _ in range(hot_n)]
+    sp_batch = SamplingParams(max_tokens=batch_tokens, temperature=0.0,
+                              stop_token_ids=())
+    sp_hot = SamplingParams(max_tokens=hot_tokens, temperature=0.0,
+                            stop_token_ids=())
+
+    # unloaded reference for the preempted class: each batch prompt alone
+    # on a plain engine — the park/resume round trip must not change a
+    # single token vs this
+    ref_eng = Engine(params, cfg, max_num_seqs=2, num_pages=64, page_size=8,
+                     max_seq_len=64, prefill_chunk=32, kv_dtype=jnp.float32)
+    ref_batch = [ref_eng.generate([p], sp_batch)[0].output_tokens
+                 for p in batch_prompts]
+
+    def run(eng: Engine):
+        done: dict = {}
+        batch_rids = [eng.add_request(p, sp_batch, priority="batch")
+                      for p in batch_prompts]
+        hot_rids: list[str] = []
+        step = added = 0
+        t0 = time.monotonic()
+        while eng.has_work() or added < hot_n:
+            if step >= warm_steps:
+                for _ in range(hot_per_step):
+                    if added < hot_n:
+                        hot_rids.append(
+                            eng.add_request(hot_prompts[added], sp_hot))
+                        added += 1
+            for res in eng.step():
+                done[res.request_id] = res
+            step += 1
+            assert step < 5000, "bench schedule wedged"
+        eng.flush_kv_migrations()
+        wall = time.monotonic() - t0
+        ttfts = sorted(
+            done[rid].timings["first_token_t"] - done[rid].timings["submit_t"]
+            for rid in hot_rids if "first_token_t" in done[rid].timings)
+        assert len(ttfts) == hot_n
+        p50 = ttfts[int(0.50 * (hot_n - 1))]
+        p99 = ttfts[int(0.99 * (hot_n - 1))]
+        outputs = [done[rid].output_tokens for rid in batch_rids + hot_rids]
+        return p50, p99, outputs, [done[rid] for rid in batch_rids], wall
+
+    out: dict[str, tuple] = {}
+    engines: dict[str, Engine] = {}
+    wd = CompileWatchdog()
+    for path in ("off", "on"):
+        # one discarded warm pass per path: JAX populates per-shape
+        # dispatch caches (eager gathers in the page-migration path, pjit
+        # fast-path entries for row buckets only this schedule reaches) on
+        # first use, process-wide.  Without it those one-time costs land
+        # as ~130 ms steps exactly where the ON path measures its TTFTs;
+        # the timed run below must see steady-state scheduling only.
+        warm = Engine(params, cfg, preempt=path, **geom)
+        warm.warmup()
+        run(warm)
+        eng = Engine(params, cfg, preempt=path, **geom)
+        eng.warmup()
+        wd.resync()
+        p50, p99, outputs, batch_res, wall = run(eng)
+        compiles = wd.sample()
+        assert compiles == 0, \
+            f"{compiles} live-traffic XLA compile(s) on the {path} path"
+        engines[path] = eng
+        out[path] = (p50, p99, outputs, batch_res)
+        emit(f"{tag}_hot_ttft_p50_ms_{path}", p50 * 1e3, "ms", None)
+        emit(f"{tag}_hot_ttft_p99_ms_{path}", p99 * 1e3, "ms", None,
+             wall_s=round(wall, 3), preemptions=eng.preemptions)
+        log(f"bench[{tag}]: {path} interactive TTFT p50 {p50 * 1e3:.1f} ms "
+            f"p99 {p99 * 1e3:.1f} ms, {eng.preemptions} preemptions, "
+            f"wall {wall:.1f}s")
+
+    # the gates: preemption is a latency change, never a token change
+    assert out["on"][2] == out["off"][2], \
+        "preemption changed tokens vs the FIFO path"
+    for res, want in zip(out["on"][3], ref_batch):
+        assert res.output_tokens == want, \
+            "preempted batch request diverged from the unloaded reference"
+        assert res.finish_reason == "length", \
+            f"batch request died: {res.finish_reason}"
+    eng = engines["on"]
+    assert eng.preemptions > 0, "saturating schedule never preempted"
+    assert eng.preempt_resumes == eng.preemptions, \
+        f"{eng.preemptions} parks but {eng.preempt_resumes} resumes"
+    assert eng.resume_recomputed_prompt_tokens == 0, \
+        f"{eng.resume_recomputed_prompt_tokens} prompt tokens recomputed"
+    assert eng.resume_faulted_pages > 0, \
+        "no resume went through host-tier fault-in"
+    assert engines["off"].preemptions == 0
+    ratio = out["on"][1] / max(out["off"][1], 1e-9)
+    emit(f"{tag}_ttft_p99_ratio", ratio, "x", None)
+    emit(f"{tag}_preemptions", eng.preemptions, "parks", None,
+         preempted_pages=eng.preempted_pages,
+         resume_faulted_pages=eng.resume_faulted_pages,
+         resume_recomputed_tokens=eng.resume_recomputed_tokens)
+    assert ratio <= 0.5, \
+        f"preempt-on TTFT p99 {ratio:.2f}x of off — ladder not engaging"
+    log(f"bench[{tag}]: preempt-on interactive TTFT p99 {ratio:.2f}x of "
+        f"FIFO, token-identical, {eng.preemptions} parks / "
+        f"{eng.preempt_resumes} resumes, {eng.resume_faulted_pages} pages "
+        f"faulted back, 0 prompt tokens recomputed, 0 live compiles")
+    return {"ratio": ratio, "preemptions": eng.preemptions,
+            "preempted_pages": eng.preempted_pages,
+            "resume_faulted_pages": eng.resume_faulted_pages,
+            "p99_on_ms": out["on"][1] * 1e3,
+            "p99_off_ms": out["off"][1] * 1e3}
+
+
 def bench_routing_pair(tag: str, *, waves: int = 4, per_wave: int = 64,
                        prefix_len: int = 48, tail_len: int = 8,
                        gen_tokens: int = 8) -> dict:
@@ -1946,6 +2091,44 @@ def _run_liveindex_cpu(artifact_dir: str) -> None:
         log(f"bench: could not write BENCH_liveindex_cpu.json ({exc})")
 
 
+def _run_preempt_cpu(artifact_dir: str) -> None:
+    """Run the preemption A/B and write its committed-artifact JSON.  Same
+    convention as the KV-tier, routing, disagg and liveindex artifacts:
+    the full CPU run writes next to bench.py, BENCH_ONLY=preempt CI
+    reruns write under artifacts/."""
+    if not budget_allows("preempt_conc128_cpu", 240):
+        return
+    before = len(_RECORDS)
+    pp = bench_preempt_pair("preempt_conc128_cpu")
+    recs = _RECORDS[before:]
+    try:
+        os.makedirs(artifact_dir, exist_ok=True)
+        with open(os.path.join(artifact_dir, "BENCH_preempt_cpu.json"), "w") as f:
+            json.dump({
+                "scenario": ("preempt_conc128 (CPU A/B; interactive TTFT "
+                             "p99 under batch saturation, page-granularity "
+                             "preemption to host tier vs FIFO)"),
+                "platform": "cpu",
+                "note": (
+                    "128 requests on identical tiered engines: 16 batch "
+                    "requests whose page footprints fill the device pool "
+                    "exactly, then 112 interactive arrivals at 2/step. "
+                    "preempt=on parks batch KV to the host tier and "
+                    "resumes via claim/fault-in; preempt=off is FIFO. "
+                    "Both paths token-identical to each other and to the "
+                    "unloaded reference, zero recomputed prompt tokens, "
+                    "zero live XLA compiles, asserted. Interactive TTFT "
+                    f"p99 on/off: {pp['ratio']:.3f}x (gate 0.5x); "
+                    f"{pp['preemptions']} preemptions, "
+                    f"{pp['resume_faulted_pages']} pages faulted back."),
+                "records": recs,
+                "summary": {r["metric"]: r["value"] for r in recs},
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        log(f"bench: could not write BENCH_preempt_cpu.json ({exc})")
+
+
 def _main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -1959,7 +2142,8 @@ def _main() -> None:
     if only:
         runners = {"kv_tier": _run_kv_tier_cpu, "routing": _run_routing_cpu,
                    "disagg": _run_disagg_cpu,
-                   "liveindex": _run_liveindex_cpu}
+                   "liveindex": _run_liveindex_cpu,
+                   "preempt": _run_preempt_cpu}
         if only not in runners:
             log(f"bench: unknown BENCH_ONLY={only!r} "
                 f"(supported: {', '.join(sorted(runners))})")
@@ -2041,6 +2225,7 @@ def _main() -> None:
         _run_routing_cpu(os.path.dirname(__file__) or ".")
         _run_disagg_cpu(os.path.dirname(__file__) or ".")
         _run_liveindex_cpu(os.path.dirname(__file__) or ".")
+        _run_preempt_cpu(os.path.dirname(__file__) or ".")
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------

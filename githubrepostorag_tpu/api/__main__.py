@@ -49,6 +49,10 @@ def _build_llm():
             prefill_widths=s.prefill_widths,
             kv_quant=s.kv_quant,
             use_pallas=jax.default_backend() == "tpu",
+            preempt=s.preempt,
+            preempt_headroom_pages=s.preempt_headroom_pages,
+            default_priority=s.priority_default_class,
+            protected_priority=s.priority_protected_class,
         )
         return InProcessLLM(AsyncEngine(engine), make_tokenizer(s.model_weights_path))
     from githubrepostorag_tpu.llm import get_llm

@@ -172,12 +172,14 @@ class RagApi:
                 status=429,
                 headers={"Retry-After": str(retry_after)},
             )
-        # SLO-plane admission hint: a critical burn rate sheds BEFORE the
-        # queue saturates — rejecting now is cheaper than timing out later
-        # (the burn only worsens if the backlog keeps growing)
+        # SLO-plane admission decision, per priority class: a critical burn
+        # rate sheds BEFORE the queue saturates — rejecting now is cheaper
+        # than timing out later (the burn only worsens if the backlog keeps
+        # growing).  Non-shed rungs (throttle/preempt) still admit here;
+        # the engine applies them where the pages are.
         from githubrepostorag_tpu.resilience.admission import should_shed
 
-        if should_shed():
+        if should_shed(req.priority or s.priority_default_class):
             JOBS_SHED.inc()
             return web.json_response(
                 {"error": "SLO burn rate critical; shedding load, retry later"},

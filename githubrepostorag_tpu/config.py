@@ -204,6 +204,25 @@ class Settings:
     # peak per-chip TFLOPs for the MFU denominator (v5e bf16 = 197)
     chip_peak_tflops: float = field(default_factory=lambda: _env_float("CHIP_PEAK_TFLOPS", 197.0))
 
+    # --- Priority classes & preempt-to-host scheduling ---
+    # SLO class stamped on requests that arrive unlabeled (API job
+    # envelope, OpenAI body, direct add_request)
+    priority_default_class: str = field(
+        default_factory=lambda: os.getenv("PRIORITY_DEFAULT_CLASS", "interactive"))
+    # the protected latency class: headroom reservations and preemption
+    # act FOR this class and AGAINST every other class
+    priority_protected_class: str = field(
+        default_factory=lambda: os.getenv("PRIORITY_PROTECTED_CLASS", "interactive"))
+    # KV pages a batch-class admission must leave allocatable for the
+    # protected class (0 = no reservation); doubles while the protected
+    # class is in SLO warn
+    preempt_headroom_pages: int = field(
+        default_factory=lambda: _env_int("PREEMPT_HEADROOM_PAGES", 0))
+    # page-granularity preempt-to-host: "on" requires the KV host tier,
+    # "off" disables, "auto" enables iff the tier is on (resume rides the
+    # claim/fault-in machinery, so a host pool is a hard prerequisite)
+    preempt: str = field(default_factory=lambda: os.getenv("PREEMPT", "auto"))
+
     # --- Fleet router (serving/multi_engine.py) ---
     # auto = affinity when any replica runs a prefix-caching allocator,
     # on = always score prefixes, off = pure weighted least-loaded

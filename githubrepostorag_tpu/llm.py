@@ -219,6 +219,14 @@ class InProcessLLM:
         )
 
     @staticmethod
+    def _priority_class() -> str | None:
+        """The job's SLO class off the thread-local scope the worker set
+        (None lets the engine apply its configured default)."""
+        from githubrepostorag_tpu.resilience.policy import current_priority
+
+        return current_priority()
+
+    @staticmethod
     def _deadline_budget() -> tuple[float | None, float]:
         """-> (engine deadline_s, caller-side timeout).  The engine gets an
         absolute monotonic deadline so it can reap the row itself at a step
@@ -254,7 +262,8 @@ class InProcessLLM:
                 fut = asyncio.run_coroutine_threadsafe(
                     self.engine.generate(self._prompt_ids(prompt, system),
                                          self._sampling(max_tokens, temperature),
-                                         deadline_s=deadline_s),
+                                         deadline_s=deadline_s,
+                                         priority=self._priority_class()),
                     loop,
                 )
                 result = fut.result(timeout=timeout)
@@ -284,10 +293,13 @@ class InProcessLLM:
         sampling = self._sampling(max_tokens, temperature)
         deadline_s, base_timeout = self._deadline_budget()
 
+        priority = self._priority_class()
+
         async def run_all():
             return await asyncio.gather(
                 *(self.engine.generate(self._prompt_ids(p, system), sampling,
-                                       deadline_s=deadline_s) for p in prompts),
+                                       deadline_s=deadline_s,
+                                       priority=priority) for p in prompts),
                 return_exceptions=True,
             )
 
@@ -340,16 +352,19 @@ class InProcessLLM:
         if sp is not None and profiler is not None:
             profiler.register(sp)
 
+        priority = self._priority_class()
+
         async def pump():
             detok = StreamingDetokenizer(self.tokenizer)
             async for event in self.engine.stream(self._prompt_ids(prompt, system),
                                                   self._sampling(max_tokens, temperature),
-                                                  deadline_s=deadline_s):
+                                                  deadline_s=deadline_s,
+                                                  priority=priority):
                 if event.type == "token":
                     delta = detok.push(event.token_id)
                     if delta:
                         sync_q.put(delta)
-                else:
+                elif event.type == "final":
                     tail = detok.flush()
                     if tail:
                         sync_q.put(tail)
@@ -411,6 +426,10 @@ class HTTPLLM:
             "temperature": s.qwen_temperature if temperature is None else temperature,
             "top_p": s.qwen_top_p,
         }
+        from githubrepostorag_tpu.resilience.policy import current_priority
+
+        if current_priority():
+            payload["priority"] = current_priority()
         try:
             resp = requests.post(
                 f"{self.endpoint}/v1/chat/completions", json=payload, timeout=self.timeout
@@ -441,6 +460,10 @@ class HTTPLLM:
             "top_p": s.qwen_top_p,
             "stream": True,
         }
+        from githubrepostorag_tpu.resilience.policy import current_priority
+
+        if current_priority():
+            payload["priority"] = current_priority()
         try:
             with requests.post(
                 f"{self.endpoint}/v1/chat/completions", json=payload,

@@ -172,6 +172,26 @@ ENGINE_DEADLINE_REAPS = Counter(
     ["replica"],
     registry=REGISTRY,
 )
+ENGINE_PREEMPTIONS = Counter(
+    "rag_engine_preemptions_total",
+    "Batch-class victims parked to the KV host tier so protected-class "
+    "admission could proceed (serving/engine.py preempt-to-host)",
+    ["replica"],
+    registry=REGISTRY,
+)
+ENGINE_PREEMPT_RESUMES = Counter(
+    "rag_engine_preempt_resumes_total",
+    "Parked victims re-admitted via prefix share + fault-in (decode "
+    "continues token-identically, no recomputed prompt prefill)",
+    ["replica"],
+    registry=REGISTRY,
+)
+ADMISSION_FAILOPEN = Counter(
+    "rag_admission_failopen_total",
+    "Admission decisions that failed open (the SLO-plane provider raised "
+    "or returned garbage; the request was accepted anyway)",
+    registry=REGISTRY,
+)
 XLA_COMPILES = Counter(
     "rag_xla_compiles_total",
     "Fresh XLA compilations observed during live engine stepping "

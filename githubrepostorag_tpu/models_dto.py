@@ -23,6 +23,10 @@ class QueryRequest(BaseModel):
     # wall-clock budget for the whole job; clamped to JOB_TIMEOUT_SECONDS
     # server-side and propagated API -> worker -> agent -> engine
     deadline_ms: Optional[int] = None
+    # SLO priority class (None -> PRIORITY_DEFAULT_CLASS).  Unknown strings
+    # are just new classes; propagated API -> worker -> agent -> engine,
+    # where it drives per-class admission, headroom, and preemption
+    priority: Optional[str] = None
 
 
 class RAGResponse(BaseModel):

@@ -165,6 +165,17 @@ class SLOMonitor:
         with self._lock:
             return max(self._state.values(), default=OK)
 
+    def class_states(self) -> dict[str, int]:
+        """Worst state per priority class across objectives — the engine's
+        preempt-to-host trigger reads this, not ``worst_state``, so a
+        burning batch class cannot make the scheduler preempt on the
+        protected class's behalf."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for (_name, klass), st in self._state.items():
+                out[klass] = max(out.get(klass, OK), st)
+            return out
+
     def transition_counts(self) -> dict[tuple[str, str, str], int]:
         with self._lock:
             return dict(self._transitions)
@@ -238,12 +249,61 @@ class SLOPlane:
                 worst = max(worst, mon.worst_state())
         return HINTS[worst]
 
+    def class_states(self) -> dict[str, int]:
+        """Fleet-federated worst state per priority class."""
+        with self._lock:
+            entries = list(self._replicas.values())
+        out: dict[str, int] = {}
+        for e in entries:
+            mon = e.get("monitor")
+            if mon is None:
+                continue
+            for klass, st in mon.class_states().items():
+                out[klass] = max(out.get(klass, OK), st)
+        return out
+
+    def decision_table(self) -> dict[str, str]:
+        """Per-class admission decisions — the graceful-degradation ladder
+        (admit -> throttle -> preempt -> shed).
+
+        The protected class is accepted while preemption can still reclaim
+        pages on its behalf: batch classes absorb the pressure (throttle at
+        protected-warn, preempt at protected-critical, shed only on their
+        OWN critical burn).  The protected class itself sheds only when it
+        is critical AND no batch class remains to preempt — which is
+        exactly the old worst-state behavior for a single-class fleet."""
+        protected = get_settings().priority_protected_class
+        states = self.class_states()
+        states.setdefault(protected, OK)
+        prot = states[protected]
+        batch_absorbing = any(
+            st < CRITICAL for k, st in states.items() if k != protected)
+        table: dict[str, str] = {}
+        for klass, own in states.items():
+            if klass == protected:
+                if prot >= CRITICAL and not batch_absorbing:
+                    table[klass] = "shed"
+                else:
+                    table[klass] = "accept"
+            elif own >= CRITICAL:
+                table[klass] = "shed"
+            elif prot >= CRITICAL:
+                table[klass] = "preempt"
+            elif prot >= WARN:
+                table[klass] = "throttle"
+            else:
+                table[klass] = HINTS[own]
+        return table
+
     def slo_payload(self) -> dict:
         s = get_settings()
         with self._lock:
             entries = sorted(self._replicas.items())
         return {
             "admission_hint": self.admission_hint(),
+            "classes": {k: STATE_NAMES[v]
+                        for k, v in sorted(self.class_states().items())},
+            "decisions": self.decision_table(),
             "config": {
                 "windows_s": list(_windows()),
                 "burn_warn": s.slo_burn_warn,
@@ -252,6 +312,8 @@ class SLOPlane:
                 "ttft_p99_ms": s.slo_ttft_p99_ms,
                 "tpot_ms": s.slo_tpot_ms,
                 "deadline_miss_budget": s.slo_deadline_miss_budget,
+                "protected_class": s.priority_protected_class,
+                "preempt_headroom_pages": s.preempt_headroom_pages,
             },
             "replicas": [
                 e["monitor"].payload()
@@ -327,16 +389,20 @@ def get_slo_plane() -> SLOPlane:
         if _plane is None:
             _plane = SLOPlane()
             # the plane is the process's hint authority; resilience keeps
-            # only a callable so it never imports obs (no cycle)
-            from githubrepostorag_tpu.resilience.admission import set_hint_provider
+            # only callables so it never imports obs (no cycle)
+            from githubrepostorag_tpu.resilience.admission import (
+                set_hint_provider, set_table_provider)
             set_hint_provider(_plane.admission_hint)
+            set_table_provider(_plane.decision_table)
         return _plane
 
 
 def reset_slo_plane() -> None:
-    """Test hook: drop the plane and its admission-hint registration."""
+    """Test hook: drop the plane and its admission registrations."""
     global _plane
     with _plane_lock:
         _plane = None
-    from githubrepostorag_tpu.resilience.admission import clear_hint_provider
+    from githubrepostorag_tpu.resilience.admission import (
+        clear_hint_provider, clear_table_provider)
     clear_hint_provider()
+    clear_table_provider()

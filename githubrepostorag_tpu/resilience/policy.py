@@ -322,3 +322,21 @@ def deadline_scope(deadline: Deadline | None):
 
 def current_deadline() -> Deadline | None:
     return getattr(_SCOPE, "deadline", None)
+
+
+@contextlib.contextmanager
+def priority_scope(klass: str | None):
+    """Bind the job's SLO priority class to the current thread, same shape
+    and rationale as ``deadline_scope``: the worker sets it around a run,
+    LLM backends read it via ``current_priority()`` and stamp it on engine
+    requests — the ``LLM`` protocol signature stays unchanged."""
+    prev = getattr(_SCOPE, "priority", None)
+    _SCOPE.priority = klass
+    try:
+        yield klass
+    finally:
+        _SCOPE.priority = prev
+
+
+def current_priority() -> str | None:
+    return getattr(_SCOPE, "priority", None)

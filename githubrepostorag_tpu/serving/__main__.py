@@ -142,6 +142,10 @@ async def serve(host: str, port: int) -> None:
             spec_iters=s.spec_iters,
             spec_accept_floor=s.spec_accept_floor,
             spec_deadline_margin_s=s.spec_deadline_margin_s,
+            preempt=s.preempt,
+            preempt_headroom_pages=s.preempt_headroom_pages,
+            default_priority=s.priority_default_class,
+            protected_priority=s.priority_protected_class,
         )
 
     if plan.dp > 1:

@@ -32,7 +32,7 @@ LIFECYCLE_STATES = ("active", "draining", "drained", "spare")
 
 @dataclass
 class StreamEvent:
-    type: str  # "token" | "final"
+    type: str  # "token" | "parked" | "final"
     token_id: int | None = None
     result: GenerationResult | None = None
 
@@ -56,7 +56,7 @@ class AsyncEngine:
                           "packed_tok": 0, "packed_pad": 0, "reaps": 0,
                           "fb": {}, "kv_fault": 0, "kv_wb": 0,
                           "kv_dedup": 0, "kv_hold": 0, "kv_mig_s": 0.0,
-                          "xfer_s": 0.0}
+                          "xfer_s": 0.0, "preempts": 0, "resumes": 0}
         # step profiler: scheduler-stall gauge + XLA compile watchdog,
         # sampled once per step on the driver thread (obs/engine_profile)
         self.profiler = EngineStepProfiler(replica=replica)
@@ -221,22 +221,44 @@ class AsyncEngine:
 
                 DISAGG_TRANSFER_SECONDS.labels(replica=R).inc(
                     xfer_s - last["xfer_s"])
+            pre = getattr(self.engine, "preemptions", 0)
+            res = getattr(self.engine, "preempt_resumes", 0)
+            if pre > last["preempts"]:
+                from githubrepostorag_tpu.metrics import ENGINE_PREEMPTIONS
+
+                ENGINE_PREEMPTIONS.labels(replica=R).inc(pre - last["preempts"])
+            if res > last["resumes"]:
+                from githubrepostorag_tpu.metrics import ENGINE_PREEMPT_RESUMES
+
+                ENGINE_PREEMPT_RESUMES.labels(replica=R).inc(
+                    res - last["resumes"])
             last.update(hit=hit, prop=self.engine.spec_proposed,
                         acc=self.engine.spec_accepted,
                         packed_tok=ptok, packed_pad=ppad, reaps=reaps,
                         kv_fault=fi, kv_wb=wb, kv_dedup=dd, kv_hold=hold,
-                        kv_mig_s=mig_s, xfer_s=xfer_s)
+                        kv_mig_s=mig_s, xfer_s=xfer_s, preempts=pre,
+                        resumes=res)
 
         from githubrepostorag_tpu.config import get_settings
 
         digest_interval = get_settings().route_digest_interval_s
         digest_next = 0.0
+        pressure_next = 0.0  # SLO class-state push, rate-limited like digest
 
         while not self._stop:
             step_start = time.monotonic()
             with self._lock:
+                if (time.monotonic() >= pressure_next
+                        and hasattr(self.engine, "set_class_pressure")):
+                    # burn-rate states feed the engine's preempt triggers
+                    # and headroom doubling (warn) — the monitor's lock is
+                    # fine to take here, the plane's federation is not
+                    self.engine.set_class_pressure(self.slo.class_states())
+                    pressure_next = time.monotonic() + 0.25
                 has_work = self.engine.has_work()
                 finished = self.engine.step() if has_work else []
+                parked = (self.engine.drain_park_events()
+                          if hasattr(self.engine, "drain_park_events") else [])
                 m_running.set(self.engine.num_running)
                 m_waiting.set(self.engine.num_waiting)
                 export_counters()
@@ -263,6 +285,12 @@ class AsyncEngine:
             else:
                 self.profiler.idle()
                 self.ledger.idle()
+            for rid in parked:
+                # advisory event: the request is parked (KV in the host
+                # tier) and will resume token-identically.  Disagg decode
+                # consumers use it to fall back fused pre-first-token;
+                # ordinary consumers just keep waiting for tokens.
+                self._emit(rid, StreamEvent(type="parked"))
             for res in finished:
                 m_tokens.inc(len(res.output_tokens))
                 if res.ttft_s is not None:
@@ -301,7 +329,7 @@ class AsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
-        priority: str = "interactive",
+        priority: str | None = None,
         on_admit=None,
     ) -> AsyncIterator[StreamEvent]:
         """Submit a request and yield token events then the final event.
@@ -318,10 +346,12 @@ class AsyncEngine:
         def on_token(rid: str, token_id: int) -> None:
             self._emit(rid, StreamEvent(type="token", token_id=token_id))
 
+        priority = priority or getattr(
+            self.engine, "default_priority", "interactive")
         with self._lock:
             rid = self.engine.add_request(
                 prompt_ids, sampling, on_token=on_token, request_id=request_id,
-                deadline_s=deadline_s,
+                deadline_s=deadline_s, priority=priority,
             )
             self._queues[rid] = q
             self._priority[rid] = priority
@@ -343,7 +373,7 @@ class AsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
-        priority: str = "interactive",
+        priority: str | None = None,
     ) -> GenerationResult:
         async for event in self.stream(prompt_ids, sampling, request_id,
                                        deadline_s=deadline_s, priority=priority):
@@ -411,4 +441,14 @@ class AsyncEngine:
                 "kv_dedup_holds": getattr(self.engine, "dedup_holds", 0),
                 "kv_pages_exported": getattr(self.engine, "kv_pages_exported", 0),
                 "kv_pages_imported": getattr(self.engine, "kv_pages_imported", 0),
+                "parked": getattr(self.engine, "num_parked", 0),
+                "preemptions": getattr(self.engine, "preemptions", 0),
+                "preempted_pages": getattr(self.engine, "preempted_pages", 0),
+                "preempt_resumes": getattr(self.engine, "preempt_resumes", 0),
+                "resume_faulted_pages": getattr(
+                    self.engine, "resume_faulted_pages", 0),
+                "resume_recomputed_tokens": getattr(
+                    self.engine, "resume_recomputed_tokens", 0),
+                "resume_recomputed_prompt_tokens": getattr(
+                    self.engine, "resume_recomputed_prompt_tokens", 0),
             }

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import itertools
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Callable
 
@@ -89,6 +89,9 @@ class GenerationResult:
     # KV tiering: prefix pages this request re-admitted from the host tier
     # instead of recomputing (0 on untiered engines)
     faulted_pages: int = 0
+    # times this request was preempt-parked to the host tier and resumed
+    # (0 = never preempted; output is token-identical either way)
+    preempted: int = 0
 
 
 @dataclass
@@ -128,6 +131,18 @@ class _Request:
     # and prefix pages served by host->device fault-in
     claimed_hashes: list[bytes] = field(default_factory=list)
     faulted_pages: int = 0
+    # priority class (SLO dimension AND scheduler input: headroom applies
+    # to every class except the engine's protected one, and only
+    # non-protected requests are preemption victims)
+    priority: str = "interactive"
+    # preempt-to-host state: after a park, ``prompt`` holds the full KV
+    # stream (original prompt + tokens generated so far) so resume is an
+    # ordinary prefix-cached admission; the original split is kept for the
+    # final GenerationResult
+    preempted: int = 0  # times parked
+    resume_pending: bool = False  # parked->waiting, first re-admission ahead
+    orig_prompt_len: int = 0  # original prompt length (0 = never parked path)
+    prior_output: list[int] = field(default_factory=list)
 
 
 from githubrepostorag_tpu.utils import next_bucket as _bucket
@@ -229,6 +244,20 @@ class Engine:
         spec_deadline_margin_s: float = 0.25,  # requests within this margin
         # of their propagated deadline also fall back: the burst-sized
         # spec dispatch has coarser stop granularity than plain decode
+        preempt: str = "auto",  # page-granularity preempt-to-host: park a
+        # batch-class victim's KV pages in the host tier (priority
+        # writeback) so a protected-class admission can proceed, and
+        # resume it later via prefix share + fault-in — decode continues
+        # token-identically with zero recomputed prompt prefill.  "on"
+        # requires the KV host tier, "off" disables, "auto" enables iff
+        # the tier is on.
+        preempt_headroom_pages: int = 0,  # KV pages a non-protected
+        # admission must leave allocatable (the protected class's
+        # reservation); doubles while the protected class is in SLO warn
+        default_priority: str = "interactive",  # class stamped on
+        # unlabeled add_request calls (PRIORITY_DEFAULT_CLASS)
+        protected_priority: str = "interactive",  # the class headroom and
+        # preemption act FOR; its requests are never victims
     ) -> None:
         self.mesh = mesh
         if mesh is not None:
@@ -427,6 +456,33 @@ class Engine:
         self.requests_admitted = 0  # cumulative add_request count
         self.deadline_reaps = 0  # requests reaped past their deadline
 
+        # ---- priority classes & preempt-to-host scheduling ----
+        if preempt not in ("auto", "on", "off"):
+            raise ValueError(f"preempt must be 'auto'|'on'|'off', got {preempt!r}")
+        if preempt == "on" and not self._kv_tier_on:
+            raise ValueError(
+                "preempt='on' requires the KV host tier (kv_tier) — resume "
+                "rides the claim/fault-in machinery, so parked victims need "
+                "a tier to survive in"
+            )
+        self._preempt_on = self._kv_tier_on and preempt != "off"
+        self.preempt_headroom_pages = max(0, preempt_headroom_pages)
+        self.default_priority = default_priority
+        self.protected_priority = protected_priority
+        # class-aware queue ordering engages only when the knobs give
+        # classes teeth; otherwise intake stays strictly FCFS
+        self._priority_sched = self._preempt_on or self.preempt_headroom_pages > 0
+        self._parked: list[_Request] = []
+        self._park_events: list[str] = []  # rids parked since last drain
+        self._class_pressure: dict[str, int] = {}  # klass -> 0 ok/1 warn/2 crit
+        self.preemptions = 0  # victims parked to the host tier
+        self.preempted_pages = 0  # pages those victims held at park time
+        self.preempt_resumes = 0  # parked victims re-admitted
+        self.resume_faulted_pages = 0  # resume pages restored by fault-in
+        self.resume_recomputed_tokens = 0  # parked-KV tokens re-prefilled
+        self.resume_recomputed_prompt_tokens = 0  # of those, PROMPT tokens
+        # (the zero-recomputed-prefill acceptance gate reads this)
+
         # SLO-plane token economics + per-phase step time (cumulative;
         # obs/ledger.py snapshots these each driver step and differences
         # them into rolling goodput / MFU / limiter attribution)
@@ -493,11 +549,14 @@ class Engine:
         on_token: TokenCallback | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
+        priority: str | None = None,
     ) -> str:
         rid = request_id or f"req-{next(self._ids)}"
         sampling = sampling or SamplingParams()
         req = _Request(request_id=rid, prompt=list(prompt_ids), sampling=sampling,
-                       on_token=on_token, deadline_ts=deadline_s)
+                       on_token=on_token, deadline_ts=deadline_s,
+                       priority=priority or self.default_priority)
+        req.orig_prompt_len = len(req.prompt)
         if len(req.prompt) + sampling.max_tokens > self.max_seq_len:
             req.sampling = sampling.clamped(self.max_seq_len - len(req.prompt))
         self._requests[rid] = req
@@ -521,8 +580,19 @@ class Engine:
             req.error = error
             self._rejected.append(req)
             return rid
-        self._waiting.append(req)
+        self._enqueue_waiting(req)
         return rid
+
+    def _enqueue_waiting(self, req: _Request) -> None:
+        """Queue a fresh arrival.  With priority scheduling on, protected-
+        class arrivals insert ahead of every batch-class waiter (FCFS within
+        the class); otherwise intake is strictly FCFS."""
+        if self._priority_sched and req.priority == self.protected_priority:
+            for i, other in enumerate(self._waiting):
+                if other.priority != self.protected_priority:
+                    self._waiting.insert(i, req)
+                    return
+        self._waiting.append(req)
 
     def cancel(self, request_id: str) -> None:
         req = self._requests.get(request_id)
@@ -530,7 +600,8 @@ class Engine:
             req.cancelled = True
 
     def has_work(self) -> bool:
-        return bool(self._waiting or self._row_req or self._rejected)
+        return bool(self._waiting or self._row_req or self._rejected
+                    or self._parked)
 
     @property
     def num_running(self) -> int:
@@ -549,6 +620,10 @@ class Engine:
     def num_waiting(self) -> int:
         return len(self._waiting)
 
+    @property
+    def num_parked(self) -> int:
+        return len(self._parked)
+
     # --------------------------------------------------------- scheduling --
 
     def step(self) -> list[GenerationResult]:
@@ -566,8 +641,12 @@ class Engine:
         self._rejected.clear()
         self._reap_expired()
         self._reap_cancelled(finished)
+        self._reap_parked(finished)
         if self._kv_tier_on:
             self._migrate_pages()
+        if self._preempt_on:
+            self._maybe_preempt(finished)
+        self._unpark_ready()
 
         t_pf = time.monotonic()
         prefilled = self._try_prefill(finished)
@@ -627,7 +706,8 @@ class Engine:
         timed out must not keep decoding to max_tokens on the device
         (the orphaned-work half of the scheduler-stall argument)."""
         now = time.monotonic()
-        for req in itertools.chain(self._waiting, self._row_req.values()):
+        for req in itertools.chain(self._waiting, self._row_req.values(),
+                                   self._parked):
             if (
                 req.deadline_ts is not None
                 and not req.cancelled
@@ -649,9 +729,199 @@ class Engine:
             if req.cancelled:
                 self._release(req)
                 if req.deadline_expired:
-                    self.reaped_tokens += len(req.output)
+                    self.reaped_tokens += len(req.output) + len(req.prior_output)
                 finished.append(self._result(
                     req, "deadline" if req.deadline_expired else "cancelled"))
+
+    def _reap_parked(self, finished: list[GenerationResult]) -> None:
+        """Finish cancelled/expired parked requests.  Their device pages
+        were returned at park time and their host copies are plain cache
+        entries the LRU trims — both tiers freed exactly once, nothing to
+        release here beyond the bookkeeping."""
+        for req in [r for r in self._parked if r.cancelled]:
+            self._parked.remove(req)
+            req.state = "done"
+            if req.deadline_expired:
+                self.reaped_tokens += len(req.output) + len(req.prior_output)
+            finished.append(self._result(
+                req, "deadline" if req.deadline_expired else "cancelled"))
+
+    # ------------------------------------------- preempt-to-host (parking) --
+
+    def set_class_pressure(self, states: dict[str, int]) -> None:
+        """Install the SLO plane's per-class burn-rate states (0 ok / 1 warn
+        / 2 critical).  AsyncEngine pushes this from its drive loop; a bare
+        engine never sees pressure and preempts only on the direct trigger
+        (protected head-of-queue infeasible)."""
+        self._class_pressure = dict(states)
+
+    def drain_park_events(self) -> list[str]:
+        """Return-and-clear the rids parked since the last drain (AsyncEngine
+        turns these into ``parked`` stream events for disagg fallback)."""
+        events, self._park_events = self._park_events, []
+        return events
+
+    def _class_headroom(self, req: _Request) -> int:
+        """KV pages this request's admission must leave allocatable.  The
+        protected class never pays its own reservation; batch admission pays
+        double while the protected class is in SLO warn (the ladder's
+        throttle rung)."""
+        if req.priority == self.protected_priority:
+            return 0
+        hr = self.preempt_headroom_pages
+        if hr and self._class_pressure.get(self.protected_priority, 0) >= 1:
+            hr *= 2
+        return hr
+
+    def _maybe_preempt(self, finished: list[GenerationResult]) -> None:
+        """Park batch-class victims to the host tier until the trigger is
+        satisfied.  Two triggers: the direct one (a protected-class request
+        heads the queue but cannot be admitted) and the SLO one (the
+        protected class burns critically — clear the headroom reservation
+        proactively so the next arrival admits without waiting a step).
+
+        Draining the in-flight chain can finish (and free) the would-be
+        victim, so each iteration drains + re-checks capacity BEFORE picking
+        a victim; parking therefore always happens with no live chain, which
+        keeps the row teardown identical to ``_release``'s immediate path."""
+        target: _Request | None = None
+        if self._waiting and self._waiting[0].priority == self.protected_priority:
+            target = self._waiting[0]
+        critical = self._class_pressure.get(self.protected_priority, 0) >= 2
+        if target is None and not critical:
+            return
+        guard = 2 * self.max_num_seqs + 8  # paranoia bound, never binds
+        while guard > 0:
+            guard -= 1
+            if target is not None:
+                need, hashes = self._head_need_hashes(target)
+                if self._free_rows and self._allocator.can_admit(hashes, need):
+                    return
+            elif self._allocator.can_admit(
+                    [], max(1, self.preempt_headroom_pages)):
+                return
+            if self._chain is not None or self._deferred:
+                # land the burst first: its commits may finish the victim
+                # we'd otherwise park, and deferred pages may be enough
+                self._drain_chain(finished)
+                continue
+            victim = self._pick_victim()
+            if victim is None:
+                return
+            self._park_victim(victim)
+            # dispatch the priority writebacks NOW so the parked pages
+            # unpin within this step — otherwise the admission this park
+            # enables would stall a boundary behind its own victim
+            while (self._allocator.pending_park_writebacks
+                   and self._migrate_pages()):
+                pass
+
+    def _pick_victim(self) -> _Request | None:
+        """Choose the running batch-class request to park: latest deadline
+        (no deadline sorts last == most preemptible), then most pages.
+        Only page-aligned victims qualify — a victim whose committed KV
+        doesn't cover its prompt would need prompt re-prefill on resume,
+        violating the zero-recomputed-prefill contract."""
+        ps = self.page_size
+        best: _Request | None = None
+        best_key: tuple = ()
+        for req in self._row_req.values():
+            if req.state != "running" or req.cancelled:
+                continue
+            if req.priority == self.protected_priority:
+                continue
+            if (req.seq_len // ps) * ps < len(req.prompt):
+                continue  # mid-prompt: resume would recompute prefill
+            key = (req.deadline_ts is None, req.deadline_ts or 0.0,
+                   len(req.pages))
+            if best is None or key > best_key:
+                best, best_key = req, key
+        return best
+
+    def _park_victim(self, req: _Request) -> None:
+        """Evict a running request's KV to the host tier and park it.
+
+        The full token stream so far (prompt + committed output) becomes the
+        request's NEW prompt; on resume, admission prefix-shares the full
+        pages back (device hit or host fault-in) and prefill recomputes only
+        the partial tail page — decode then continues token-identically.
+        ``max_tokens`` shrinks by the tokens already produced, so the
+        combined budget (and every stop condition) is unchanged."""
+        ps = self.page_size
+        stream = req.prompt + req.output
+        full = req.seq_len // ps  # pages whose KV is fully committed
+        hashes = page_hashes(stream[: full * ps], ps)
+        if req.claimed_hashes:
+            self._allocator.unclaim(req.claimed_hashes)
+            req.claimed_hashes = []
+        for j in range(req.pages_registered, full):
+            # first-writer-wins: registering an already-known hash is a no-op
+            self._allocator.register(hashes[j], req.pages[j])
+        pages, req.pages = req.pages, []
+        self.preempted_pages += len(pages)
+        self._allocator.park(pages)
+        row = req.row
+        self._free_rows.append(row)
+        self._row_req.pop(row, None)
+        self._seq_lens[row] = 0
+        self._block_tables[row] = 0
+        self._row_limits[row] = 0
+        self._temp[row] = 1.0
+        self._top_p[row] = 1.0
+        self._top_k[row] = 0
+        self._rep_pen[row] = 1.0
+        req.row = -1
+        produced = len(req.output)
+        req.prior_output.extend(req.output)
+        req.prompt = stream
+        req.output = []
+        req.page_hashes = []  # stale: recomputed from the folded prompt
+        req.pages_registered = 0
+        req.cached_tokens = 0
+        req.prefill_pos = 0
+        req.seq_len = 0
+        if produced:
+            remaining = max(1, req.sampling.max_tokens - produced)
+            req.sampling = replace(req.sampling, max_tokens=remaining)
+        req.state = "parked"
+        req.preempted += 1
+        req.resume_pending = False
+        self._parked.append(req)
+        self._park_events.append(req.request_id)
+        self.preemptions += 1
+
+    def _unpark_ready(self) -> None:
+        """Move parked requests whose pages fit back to the waiting queue,
+        earliest deadline first.  Holds everything while the protected class
+        is still critical (anti-thrash: un-parking into the pressure that
+        caused the park just cycles pages through the tier)."""
+        if not self._parked:
+            return
+        if self._class_pressure.get(self.protected_priority, 0) >= 2:
+            return
+        self._parked.sort(
+            key=lambda r: (r.deadline_ts is None, r.deadline_ts or 0.0))
+        while self._parked:
+            req = self._parked[0]
+            need, hashes = self._head_need_hashes(req)
+            if not self._free_rows or not self._allocator.can_admit(
+                    hashes, need, headroom=self._class_headroom(req)):
+                break  # deadline order: later victims don't jump the head
+            self._parked.pop(0)
+            req.state = "waiting"
+            req.resume_pending = True
+            self._requeue_resumed(req)
+
+    def _requeue_resumed(self, req: _Request) -> None:
+        """Resumed victims queue behind the protected block but ahead of
+        queued batch arrivals — they already ran once and hold host-tier
+        state worth reusing soon."""
+        for i, other in enumerate(self._waiting):
+            if (other.priority != self.protected_priority
+                    or req.priority == self.protected_priority):
+                self._waiting.insert(i, req)
+                return
+        self._waiting.append(req)
 
     def _migrate_pages(self) -> bool:
         """Step-boundary device->host page migration (tiered engines only).
@@ -960,7 +1230,8 @@ class Engine:
         extra = sum(
             self._allocator.releasable_count(pages) for _, pages in self._deferred
         )
-        return rows_avail and self._allocator.can_admit(hashes, need, extra_free=extra)
+        return rows_avail and self._allocator.can_admit(
+            hashes, need, extra_free=extra, headroom=self._class_headroom(req))
 
     def _try_prefill(self, finished: list[GenerationResult]) -> bool:
         """Admit every waiting request the pool can back, then run ONE
@@ -975,7 +1246,8 @@ class Engine:
         if self._waiting:
             req0 = self._waiting[0]
             need0, hashes0 = self._head_need_hashes(req0)
-            can_free = bool(self._free_rows) and self._allocator.can_admit(hashes0, need0)
+            can_free = bool(self._free_rows) and self._allocator.can_admit(
+                hashes0, need0, headroom=self._class_headroom(req0))
             if not can_free and self._admission_feasible():
                 self._drain_chain(finished)
         # admit as many waiting requests as rows + pages allow
@@ -984,6 +1256,16 @@ class Engine:
             req = self._waiting[0]
             need, hashes = self._head_need_hashes(req)
             assert need <= self.max_pages_per_seq, "intake clamp must bound the page need"
+            if (req.priority != self.protected_priority and self._preempt_on
+                    and self._class_pressure.get(
+                        self.protected_priority, 0) >= 2):
+                # ladder rung 3: while the protected class burns critically,
+                # batch admission pauses entirely — every free page belongs
+                # to the class we're preempting FOR
+                break
+            if not self._allocator.can_admit(
+                    hashes, need, headroom=self._class_headroom(req)):
+                break  # headroom reservation: batch leaves protected room
             if self._kv_tier_on and hashes:
                 pending = self._allocator.pending_claim_pages(hashes)
                 if pending and self._allocator.plain_free_count < need:
@@ -1009,7 +1291,7 @@ class Engine:
             req.row, req.pages, req.state = row, pages, "prefilling"
             req.prefill_start_t = time.monotonic()
             if self._kv_tier_on:
-                req.faulted_pages = self._allocator.fault_ins - faults_before
+                req.faulted_pages += self._allocator.fault_ins - faults_before
                 claimed = hashes[len(shared):]
                 if claimed:
                     # promise the pages this prefill will register, so
@@ -1024,6 +1306,21 @@ class Engine:
             req.pages_registered = len(shared)
             if shared:
                 self._allocator.hit_tokens += req.cached_tokens
+            if req.resume_pending:
+                # a parked victim is back: its folded prompt prefix-shared
+                # the full pages it parked (device hit or host fault-in);
+                # prefill recomputes only the partial tail page.  The gate
+                # counters below prove the zero-recomputed-prefill contract.
+                req.resume_pending = False
+                self.preempt_resumes += 1
+                if self._kv_tier_on:
+                    self.resume_faulted_pages += (
+                        self._allocator.fault_ins - faults_before)
+                kv_at_park = len(req.prompt) - 1  # KV the victim had parked
+                self.resume_recomputed_tokens += max(
+                    0, kv_at_park - req.cached_tokens)
+                self.resume_recomputed_prompt_tokens += max(
+                    0, req.orig_prompt_len - req.cached_tokens)
             self._row_req[row] = req
             self._block_tables[row, : len(pages)] = pages
             self._seq_lens[row] = req.cached_tokens
@@ -1964,10 +2261,16 @@ class Engine:
         self._requests.pop(req.request_id, None)
         ttft = (req.first_token_t - req.submit_t) if req.first_token_t else None
         done_t = time.monotonic()
+        # a parked request folded prompt+output into its prompt; report the
+        # caller's original prompt and the full contiguous output stream
+        output = req.prior_output + req.output if req.prior_output else req.output
+        prompt = req.prompt
+        if req.orig_prompt_len and req.orig_prompt_len < len(req.prompt):
+            prompt = req.prompt[: req.orig_prompt_len]
         return GenerationResult(
             request_id=req.request_id,
-            prompt_tokens=req.prompt,
-            output_tokens=req.output,
+            prompt_tokens=prompt,
+            output_tokens=output,
             finish_reason=reason,
             ttft_s=ttft,
             decode_time_s=(done_t - req.first_token_t) if req.first_token_t else 0.0,
@@ -1981,6 +2284,7 @@ class Engine:
             spec_accepted=req.spec_accepted_req,
             spec_fallback=req.spec_fallback,
             faulted_pages=req.faulted_pages,
+            preempted=req.preempted,
         )
 
     # --------------------------------------------------------- convenience --

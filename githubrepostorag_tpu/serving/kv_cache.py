@@ -182,11 +182,14 @@ class PageAllocator:
     def release(self, pages: list[int]) -> None:
         self._free.extend(pages)
 
-    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0) -> bool:
+    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0,
+                  headroom: int = 0) -> bool:
         """Interface parity with PrefixCachingAllocator (no cache here, so
         ``hashes`` — duplicates included — never changes the answer, and
-        ``need=0`` trivially admits)."""
-        return self.free_count + extra_free >= need
+        ``need=0`` trivially admits).  ``headroom`` pages must remain
+        allocatable AFTER the admission (the per-class reservation batch
+        traffic pays and protected traffic doesn't)."""
+        return self.free_count + extra_free >= need + headroom
 
     def releasable_count(self, pages: list[int]) -> int:
         """Interface parity: without refcounts every page frees on release."""
@@ -270,14 +273,16 @@ class PrefixCachingAllocator:
 
     # ---------------------------------------------------------- prefix API --
 
-    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0) -> bool:
+    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0,
+                  headroom: int = 0) -> bool:
         """Would ``share(hashes)`` + ``allocate(need - matched)`` succeed
-        right now (plus ``extra_free`` pages the caller could recycle first)?
-        Matched pages that are parked in the LRU must not double-count as
-        allocatable free pages — sharing removes them from the LRU.  A page
-        can match at most ONCE per admission (degenerate prompts can repeat
-        a chain hash; a block table may list a page twice, but each listing
-        is a separate refcount, i.e. a separate claim on capacity)."""
+        right now (plus ``extra_free`` pages the caller could recycle first)
+        while leaving ``headroom`` pages allocatable?  Matched pages that
+        are parked in the LRU must not double-count as allocatable free
+        pages — sharing removes them from the LRU.  A page can match at
+        most ONCE per admission (degenerate prompts can repeat a chain
+        hash; a block table may list a page twice, but each listing is a
+        separate refcount, i.e. a separate claim on capacity)."""
         matched = parked = 0
         seen: set[int] = set()
         for h in hashes:
@@ -289,7 +294,7 @@ class PrefixCachingAllocator:
             if page in self._lru:
                 parked += 1
         avail = len(self._free) + len(self._lru) - parked + extra_free
-        return avail >= need - matched
+        return avail >= need - matched + headroom
 
     def share(self, hashes: list[bytes]) -> list[int]:
         """Claim the longest cached run matching ``hashes``: refcounts bump,
@@ -379,8 +384,14 @@ class TieredPageAllocator(PrefixCachingAllocator):
         # (device page, payload) scatters staged by share(); the engine
         # drains via fault_in() and dispatches before dependent programs
         self._staged_faults: list[tuple[int, object]] = []
+        # preempt-park priority queue: chain hashes whose device copy is a
+        # parked victim's ONLY copy.  evict() serves these before the
+        # cold-first scan, and until their writeback dispatches the pages
+        # are pinned (excluded from free_count / _pick_eviction)
+        self._park_queue: dict[bytes, None] = {}
         # cumulative stats (async engine exports deltas)
         self.fault_ins = 0  # host->device re-admissions
+        self.preempt_parked_pages = 0  # pages parked by preemption
         self.writebacks = 0  # device->host saves completed
         self.dedup_hits = 0  # share() hits on pages other requests hold
         self.host_evictions = 0  # host-LRU payloads dropped at capacity
@@ -400,6 +411,32 @@ class TieredPageAllocator(PrefixCachingAllocator):
     def plain_free_count(self) -> int:
         """Free pages available without evicting anything from the cache."""
         return len(self._free)
+
+    @property
+    def pending_park_writebacks(self) -> int:
+        """Park-queue entries not yet drained by ``evict`` — the engine's
+        preempt path loops migration until this hits zero so parked pages
+        unpin within the step that parked them."""
+        return len(self._park_queue)
+
+    def _pinned_hashes(self) -> set[bytes]:
+        """Park-queue hashes whose device page is still the only copy:
+        LRU-resident, not yet saved or in flight.  Stale entries (re-shared
+        pages, already-saved hashes) don't pin — evict() drops them."""
+        out: set[bytes] = set()
+        for h in self._park_queue:
+            page = self._hash_to_page.get(h)
+            if (page is not None and page in self._lru
+                    and h not in self._host and h not in self._wb_inflight):
+                out.add(h)
+        return out
+
+    @property
+    def free_count(self) -> int:
+        # pinned pages are NOT allocatable until their writeback dispatches
+        # (one _migrate_pages step at most): reclaiming one would destroy a
+        # preempted victim's only KV copy
+        return len(self._free) + len(self._lru) - len(self._pinned_hashes())
 
     # ------------------------------------------------------------ device --
 
@@ -424,22 +461,37 @@ class TieredPageAllocator(PrefixCachingAllocator):
     def _pick_eviction(self) -> int:
         # prefer the coldest SAVED parked page — its hash survives in host
         # RAM, so the device copy is free to drop; fall back to the coldest
-        # overall (the hash is lost, exactly the base-class economics)
+        # overall (the hash is lost, exactly the base-class economics).
+        # Preempt-pinned pages are skipped in both passes: free_count
+        # excludes them, so a caller that passed the allocate() precheck is
+        # guaranteed an unpinned candidate here.
+        pinned = self._pinned_hashes()
+        fallback = None
         for page in self._lru:
             h = self._page_to_hash[page]
+            if h in pinned:
+                continue
             if h in self._host or h in self._wb_inflight:
                 return page
-        return next(iter(self._lru))
+            if fallback is None:
+                fallback = page
+        if fallback is None:
+            raise OutOfPages("every cached page is preempt-pinned")
+        return fallback
 
     # -------------------------------------------------------- prefix API --
 
-    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0) -> bool:
+    def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0,
+                  headroom: int = 0) -> bool:
         """Host-resident hash hits count as free-able capacity: a host hit
         still consumes a device page (the fault-in target, included in
         ``need``) but extends the shareable run instead of breaking it, and
         saved parked pages reclaim at zero cache cost.  Device-matched
         pages reduce the allocation need as in the base class (with the
-        same one-match-per-page rule)."""
+        same one-match-per-page rule).  Preempt-pinned pages aren't
+        allocatable — unless this admission's own run matches them, which
+        is the resume fast path (sharing un-pins)."""
+        pinned = self._pinned_hashes()
         matched = parked = 0
         seen: set[int] = set()
         for h in hashes:
@@ -451,12 +503,14 @@ class TieredPageAllocator(PrefixCachingAllocator):
                 matched += 1
                 if page in self._lru:
                     parked += 1
+                pinned.discard(h)  # matched: counted once via ``parked``
                 continue
             if h in self._host:
                 continue  # fault-in target: needs a page, run continues
             break
-        avail = len(self._free) + len(self._lru) - parked + extra_free
-        return avail >= need - matched
+        avail = (len(self._free) + len(self._lru) - parked - len(pinned)
+                 + extra_free)
+        return avail >= need - matched + headroom
 
     def share(self, hashes: list[bytes]) -> list[int]:
         """Claim the longest run servable from EITHER tier.  Device hits
@@ -509,6 +563,24 @@ class TieredPageAllocator(PrefixCachingAllocator):
         DMA lands.  Refcounted pages never appear (not in the LRU)."""
         out: list[tuple[int, bytes]] = []
         cap = self.host_pool_pages
+        # preempt-parked hashes jump the queue: each is a victim's ONLY
+        # copy and pins its device page until saved, so clearing them first
+        # keeps the pin (which subtracts from free_count) one step long.
+        # The host cap is not consulted — complete_writeback's LRU trim
+        # makes room by dropping the coldest host payloads instead.
+        drained: list[bytes] = []
+        for h in self._park_queue:
+            if len(out) >= max_n:
+                break
+            drained.append(h)  # served or stale either way
+            page = self._hash_to_page.get(h)
+            if (page is None or page not in self._lru
+                    or h in self._host or h in self._wb_inflight):
+                continue  # re-shared, reclaimed, or already saved
+            self._wb_inflight.add(h)
+            out.append((page, h))
+        for h in drained:
+            del self._park_queue[h]
         for page in self._lru:
             if len(out) >= max_n:
                 break
@@ -520,6 +592,32 @@ class TieredPageAllocator(PrefixCachingAllocator):
             self._wb_inflight.add(h)
             out.append((page, h))
         return out
+
+    def park(self, pages: list[int]) -> int:
+        """Preempt-park a victim's pages (the WPA004 ``park`` transition).
+
+        Registered pages release into the LRU exactly like an ordinary
+        ``release`` but jump the writeback queue: their hashes pin the
+        device pages against reclaim until the payload is saved to host,
+        so the very pool churn that triggered the preemption cannot
+        destroy the victim's only KV copy before ``evict`` ships it.
+        Unregistered pages (the partial tail) just free — their content
+        has no chain hash to resume under and is recomputed at resume.
+        Pages other requests still share stay device-resident and
+        refcounted (nothing to save).  Returns how many pages remain
+        resumable by ``share`` from either tier."""
+        resumable = 0
+        for page in pages:
+            h = self._page_to_hash.get(page)
+            if h is None:
+                continue
+            resumable += 1
+            if self._rc.get(page, 0) <= 1 and not (
+                    h in self._host or h in self._wb_inflight):
+                self._park_queue[h] = None
+        self.release(pages)
+        self.preempt_parked_pages += len(pages)
+        return resumable
 
     def complete_writeback(self, h: bytes, payload: object) -> None:
         """Store a landed writeback payload under its chain hash.  Content

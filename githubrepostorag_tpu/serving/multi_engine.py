@@ -372,11 +372,13 @@ class MultiAsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
-        priority: str = "interactive",
+        priority: str | None = None,
     ) -> AsyncIterator[StreamEvent]:
         # engines generate per-engine "req-N" ids that would collide across
         # replicas; mint a process-unique id when the caller didn't
         rid = request_id or f"mreq-{next(self._ids)}"
+        priority = priority or getattr(
+            self._engines[0].engine, "default_priority", "interactive")
         if self._disagg:
             events = self._stream_disagg(prompt_ids, sampling, rid,
                                          deadline_s, priority)
@@ -446,7 +448,7 @@ class MultiAsyncEngine:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float | None = None,
-        priority: str = "interactive",
+        priority: str | None = None,
     ) -> GenerationResult:
         async for event in self.stream(prompt_ids, sampling, request_id,
                                        deadline_s=deadline_s, priority=priority):
@@ -584,14 +586,27 @@ class MultiAsyncEngine:
                           deduped=deduped)
 
         yielded = False
+        parked = False
         try:
             async for event in self._stream_on(
                 dest, dgrant, prompt_ids, sampling, rid, deadline_s,
                 priority,
             ):
+                if event.type == "parked" and not yielded:
+                    # the decode replica preempted this request before its
+                    # first token: rather than wait out the park, cancel it
+                    # there and finish fused on the prefill replica, which
+                    # still holds the whole prefix hot.  Once tokens have
+                    # flowed, a park is just latency — the resume is
+                    # token-identical, so keep consuming.
+                    parked = True
+                    break
                 yielded = True
                 yield event
-            return
+            if not parked:
+                return
+            await dest.cancel(rid)
+            self._handoff_fallback("preempted")
         except Exception:
             if yielded:
                 # tokens already reached the caller: replaying from the

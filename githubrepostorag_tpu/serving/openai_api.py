@@ -179,7 +179,10 @@ class OpenAIServer:
         rid = f"chatcmpl-{uuid.uuid4().hex}" if chat else f"cmpl-{uuid.uuid4().hex}"
         # SLO priority class; unknown strings are just new classes (the
         # monitor keys on them), so no validation beyond type
-        priority = str(body.get("priority") or "interactive")
+        from githubrepostorag_tpu.config import get_settings
+
+        priority = str(
+            body.get("priority") or get_settings().priority_default_class)
         if body.get("stream"):
             return await self._serve_stream(request, sampling, prompt_ids, rid, chat,
                                             priority=priority)
@@ -198,8 +201,10 @@ class OpenAIServer:
                     await self.engine.cancel(rid)
                     text_parts = [full[:hit]]
                     stopped_on_string = True
-            else:
+            elif event.type == "final":
                 result = event.result
+            # "parked" (preempt-to-host) is advisory: the request resumes
+            # token-identically, so just keep waiting
         text_parts.append("" if stopped_on_string else detok.flush())
         text = "".join(text_parts)
         finish = "stop" if stopped_on_string else _map_finish(result)
@@ -279,7 +284,7 @@ class OpenAIServer:
                         continue
                     if delta and finish is None:
                         await send(self._chunk(rid, chat, delta, None))
-                else:
+                elif event.type == "final":
                     if finish is None:
                         tail = detok.flush()
                         if tail:

@@ -165,6 +165,9 @@ class RagWorker:
         top_k = req.get("top_k")
         if not isinstance(top_k, int) or isinstance(top_k, bool) or top_k <= 0:
             top_k = None
+        # SLO priority class off the job envelope; the scope below hands it
+        # to the agent's LLM calls the same way the deadline travels
+        priority = req.get("priority") or get_settings().priority_default_class
         start = time.monotonic()
 
         await self.bus.emit(job_id, "started", {"job_id": job_id, "query": query})
@@ -208,16 +211,22 @@ class RagWorker:
         # run_in_executor does NOT propagate contextvars — hand the trace
         # context to the agent explicitly, like the deadline
         trace_ctx = current_context()
-        try:
-            result = await loop.run_in_executor(
-                None,
-                lambda: self.agent.run(
+
+        def run_with_priority():
+            # priority_scope is thread-local, so it must be entered INSIDE
+            # the executor thread the agent (and its LLM calls) run on
+            from githubrepostorag_tpu.resilience.policy import priority_scope
+
+            with priority_scope(priority):
+                return self.agent.run(
                     query, namespace=namespace, progress_cb=progress_cb,
                     force_level=force_level, should_stop=cancelled.is_set,
                     token_cb=token_cb, top_k=top_k, deadline=deadline,
                     trace=trace_ctx,
-                ),
-            )
+                )
+
+        try:
+            result = await loop.run_in_executor(None, run_with_priority)
         except RunCancelled:
             await self.bus.emit(job_id, "final", {"answer": "", "sources": [], "cancelled": True})
             await self.queue.set_result(job_id, {"answer": "", "sources": [], "cancelled": True})

@@ -65,6 +65,15 @@ echo "== live-index streaming A/B (CPU-tiny) =="
 # and watermark-gauge publishing inside the 2% obs budget.
 BENCH_ONLY=liveindex JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
 
+echo "== preemption A/B (CPU-tiny) =="
+# preempt=on vs preempt=off on the same 128-request saturating schedule
+# over identical tiered engines: bench_preempt_pair asserts interactive
+# TTFT p99 with preemption at or under 0.5x FIFO, both paths (and the
+# unloaded reference) token-identical, every victim resumed via host-tier
+# fault-in with zero recomputed prompt tokens, and zero live-traffic XLA
+# recompiles across park/resume.
+BENCH_ONLY=preempt JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

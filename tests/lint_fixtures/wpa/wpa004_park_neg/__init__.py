@@ -1,0 +1,3 @@
+"""WPA004 park negative: both legal closes of a parked handle — the
+resume path (victim re-admits, ownership returns, eventually released)
+and the reap path (released while parked)."""

@@ -1,0 +1,17 @@
+from wpa004_park_neg.pool import PagePool
+
+
+class Scheduler:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def preempt_then_resume(self, n):
+        pages = self.pool.allocate(n)
+        self.pool.park(pages)  # victim parked to the host tier
+        self.pool.resume(pages)  # re-admitted: ownership returns
+        self.pool.release(pages)
+
+    def preempt_then_reap(self, n):
+        pages = self.pool.allocate(n)
+        self.pool.park(pages)
+        self.pool.release(pages)  # reaped while parked: legal close

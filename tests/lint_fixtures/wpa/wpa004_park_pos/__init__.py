@@ -1,0 +1,3 @@
+"""WPA004 park positive: a victim parked and then dropped (never resumed
+nor released — the parked-leak shape) and a freed handle parked
+afterwards (use-after-release)."""

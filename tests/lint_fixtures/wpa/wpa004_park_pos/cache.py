@@ -1,0 +1,16 @@
+from wpa004_park_pos.pool import PagePool
+
+
+class Scheduler:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def preempt_and_forget(self, n):
+        pages = self.pool.allocate(n)
+        self.pool.park(pages)
+        return None  # parked, never resumed nor released: the victim leaks
+
+    def park_after_free(self, n):
+        pages = self.pool.allocate(n)
+        self.pool.release(pages)
+        self.pool.park(pages)  # use-after-release: pages already freed

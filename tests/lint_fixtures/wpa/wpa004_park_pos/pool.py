@@ -1,0 +1,24 @@
+class OutOfPages(Exception):
+    pass
+
+
+class PagePool:
+    def __init__(self, n=8):
+        self.free = list(range(n))
+        self.parked = []
+
+    def allocate(self, n):
+        if n > len(self.free):
+            raise OutOfPages()
+        out, rest = self.free[:n], self.free[n:]
+        self.free = rest
+        return out
+
+    def park(self, pages):
+        self.parked.extend(pages)
+
+    def resume(self, pages):
+        self.parked = [p for p in self.parked if p not in pages]
+
+    def release(self, pages):
+        self.free.extend(pages)

@@ -1,0 +1,2 @@
+"""WPA004 park suppressed: the parked-leak shape silenced with a
+justified directive at the drop site."""

@@ -1,0 +1,12 @@
+from wpa004_park_sup.pool import PagePool
+
+
+class Scheduler:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def preempt_for_drain(self, n):
+        pages = self.pool.allocate(n)
+        self.pool.park(pages)
+        # tpulint: disable=WPA004 -- drain-mode park: the shutdown sweep releases every parked handle in bulk after the fleet quiesces
+        return None

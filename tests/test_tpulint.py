@@ -278,6 +278,33 @@ def test_wpa004_tier_suppressed_is_silenced_with_justification():
     assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
 
 
+# Preemption extends the WPA004 alphabet once more: park() surrenders a
+# victim's pages to the host tier but keeps the handle accountable — it
+# must later be resumed (ownership returns) or released (deadline reap).
+# Dropping a parked handle strands host-tier pages forever; parking or
+# resuming a released handle is a use-after-release.
+
+def test_wpa004_park_positive_catches_leak_and_use_after_release():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_park_pos"])
+    messages = [f.message for f in findings if f.rule == "WPA004"]
+    assert any("parked page leak" in m for m in messages), messages
+    assert any("use-after-release" in m for m in messages), messages
+
+
+def test_wpa004_park_negative_is_silent():
+    # both legal closes: park -> resume -> release, and park -> release
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_park_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_wpa004_park_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_park_sup"])
+    hits = [f for f in findings if f.rule == "WPA004"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
 # Disaggregated serving extends the WPA004 alphabet again: export_pages()
 # puts a handle in flight toward a peer pool and import_pages() lands it.
 # The checker must prove every export reaches exactly one import or a

@@ -889,10 +889,18 @@ _TIER_METHODS = {"evict", "fault_in"}
 # import clobbers whatever the peer did with the first)
 _EXPORT_METHODS = {"export_pages", "export_kv_pages"}
 _IMPORT_METHODS = {"import_pages", "import_kv_pages"}
+# preempt-to-host parking: park() moves a victim's pages out of the live
+# working set (device copies pinned until saved to the host tier).  A
+# parked handle is suspended, not closed — it must later either resume
+# (the victim re-admits, ownership returns) or release (the victim was
+# reaped while parked); dropping it strands pages in the host tier under
+# hashes nothing will ever share again
+_PARK_METHODS = {"park", "preempt"}
+_RESUME_METHODS = {"resume", "unpark"}
 _POOLISH_RE = re.compile(r"alloc|pool|page", re.IGNORECASE)
 
 OWNED, MAYBE, RELEASED, ESCAPED = "owned", "maybe", "released", "escaped"
-EXPORTED, IMPORTED = "exported", "imported"
+EXPORTED, IMPORTED, PARKED = "exported", "imported", "parked"
 
 
 def _pool_classes(program: Program) -> set[str]:
@@ -918,7 +926,8 @@ class _PoolOps:
             return None
         last = d.rsplit(".", 1)[-1]
         if last not in (_ALLOC_METHODS | _RELEASE_METHODS | _TIER_METHODS
-                        | _EXPORT_METHODS | _IMPORT_METHODS):
+                        | _EXPORT_METHODS | _IMPORT_METHODS
+                        | _PARK_METHODS | _RESUME_METHODS):
             return None
         resolved = self.program._resolve_dotted_call(d, self.fn)
         is_pool = any(m.cls is not None and m.cls.qualname in self.pools
@@ -936,6 +945,10 @@ class _PoolOps:
             return "export"
         if last in _IMPORT_METHODS:
             return "import"
+        if last in _PARK_METHODS:
+            return "park"
+        if last in _RESUME_METHODS:
+            return "resume"
         return "release"
 
 
@@ -982,10 +995,11 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
                         f"'{fn.qualname}' — pages already returned to the "
                         f"pool (refcount corruption / page reuse)",
                     ))
-                elif state in {OWNED, MAYBE, EXPORTED, IMPORTED}:
+                elif state in {OWNED, MAYBE, EXPORTED, IMPORTED, PARKED}:
                     # releasing an exported handle is the abandon path of
                     # a failed transfer; releasing an imported one ends
-                    # the handle's life normally — both are legal closes
+                    # the handle's life normally; releasing a parked one
+                    # is the reap-while-parked path — all legal closes
                     env[arg.id] = RELEASED
                 res.release_attrs.update(derived_from.get(arg.id, ()))
             elif isinstance(arg, ast.Attribute):
@@ -1051,6 +1065,40 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
                 elif state in {OWNED, MAYBE, EXPORTED}:
                     env[arg.id] = IMPORTED
 
+    def handle_park(call: ast.Call, env: dict[str, str]) -> None:
+        # park suspends ownership: the handle must later resume (the
+        # victim re-admits) or release (reaped while parked).  Parking a
+        # released handle writes host-tier state for pages that may
+        # already belong to another request.
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                state = env.get(arg.id)
+                if state == RELEASED:
+                    res.findings.append((
+                        call.lineno, call.col_offset,
+                        f"use-after-release: '{arg.id}' parked in "
+                        f"'{fn.qualname}' after its pages were released — "
+                        f"the park saves pages that may already belong to "
+                        f"another request",
+                    ))
+                elif state in {OWNED, MAYBE}:
+                    env[arg.id] = PARKED
+
+    def handle_resume(call: ast.Call, env: dict[str, str]) -> None:
+        for arg in call.args:
+            if isinstance(arg, ast.Name):
+                state = env.get(arg.id)
+                if state == RELEASED:
+                    res.findings.append((
+                        call.lineno, call.col_offset,
+                        f"use-after-release: '{arg.id}' resumed in "
+                        f"'{fn.qualname}' after its pages were released — "
+                        f"resume re-admits pages that may already belong "
+                        f"to another request",
+                    ))
+                elif state == PARKED:
+                    env[arg.id] = OWNED  # ownership returns; must release
+
     def handle_calls(stmt: ast.AST, env: dict[str, str]) -> None:
         """Release calls + owned-var escapes through arbitrary calls."""
         for node in ast.walk(stmt):
@@ -1065,9 +1113,13 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
                 handle_export(node, env)
             elif kind == "import":
                 handle_import(node, env)
+            elif kind == "park":
+                handle_park(node, env)
+            elif kind == "resume":
+                handle_resume(node, env)
             elif kind is None:
                 for name in names_read(node):
-                    if env.get(name) in {OWNED, MAYBE, EXPORTED}:
+                    if env.get(name) in {OWNED, MAYBE, EXPORTED, PARKED}:
                         env[name] = ESCAPED
 
     def leak_check(line: int, col: int, env: dict[str, str], what: str) -> None:
@@ -1088,6 +1140,16 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
                     f"'{fn.qualname}' {what} — an exported handle must "
                     f"reach exactly one import or release; dropping it "
                     f"strands the pages on both ends of the transfer",
+                ))
+                env[var] = ESCAPED  # report once
+            elif env[var] == PARKED:
+                res.findings.append((
+                    line, col,
+                    f"parked page leak: '{var}' is still parked when "
+                    f"'{fn.qualname}' {what} — a parked handle must be "
+                    f"resumed (the victim re-admits) or released (reaped "
+                    f"while parked); dropping it strands pages in the "
+                    f"host tier that nothing will ever share again",
                 ))
                 env[var] = ESCAPED  # report once
 
@@ -1168,7 +1230,7 @@ def _analyze_pool_function(program: Program, fn: FuncInfo,
         if isinstance(stmt, ast.Return):
             handle_calls(stmt, env)
             for n in names_read(stmt.value):
-                if env.get(n) in {OWNED, MAYBE, EXPORTED}:
+                if env.get(n) in {OWNED, MAYBE, EXPORTED, PARKED}:
                     env[n] = ESCAPED  # ownership transferred to caller
             leak_check(stmt.lineno, stmt.col_offset, env, "returns")
             return env
