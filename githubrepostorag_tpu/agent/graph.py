@@ -60,6 +60,15 @@ TECH_SYNONYMS: dict[str, tuple[str, ...]] = {
 
 _REPO_HINT_RE = re.compile(r"(?:repo(?:sitory)?[:\s]+)([\w\-./]+)", re.IGNORECASE)
 _OVERVIEW_TERMS = ("projects", "repositories", "overview", "tell me about", "what is", "describe")
+# Architecture-class questions: cross-cutting structure that no 5-block
+# chunk context can answer well.  With a repo identified, these route to
+# the whole-repo long-context mode (retrieval/assembler.py feeds the
+# serving stack's ring-prefill path) instead of the iterative RAG loop.
+_ARCHITECTURE_TERMS = (
+    "architecture", "how does", "how do", "design", "structure",
+    "data flow", "end to end", "end-to-end", "walk me through",
+    "walk through", "overall", "interact", "fit together", "lifecycle",
+)
 _CONSERVATIVE_PHRASES = (
     "insufficient", "don't see enough", "don't have enough", "can't answer",
     "not enough information", "cannot answer", "no information",
@@ -74,6 +83,14 @@ SYNTH_MAX_BLOCKS = 5
 def looks_codey(query: str) -> bool:
     ql = query.lower()
     return any(term in ql for term in _CODEY_TERMS)
+
+
+def wants_whole_repo(query: str) -> bool:
+    """Architecture-class question — the whole repo beats any 5 chunks.
+    Snippet-smelling questions (looks_codey) stay on chunk RAG: they want
+    one precise fragment, not 11k tokens of everything."""
+    ql = query.lower()
+    return any(term in ql for term in _ARCHITECTURE_TERMS) and not looks_codey(query)
 
 
 def extract_repo_hint(query: str) -> str | None:
@@ -116,6 +133,7 @@ class GraphAgent:
         self.max_iters = max_iters or s.max_rag_attempts
         self.namespace = namespace
         self.router_top_k = s.router_top_k
+        self.longctx = s.agent_longctx
 
     # ------------------------------------------------------------- stages
 
@@ -148,9 +166,20 @@ class GraphAgent:
                 break
 
         state.scope = scope
+        # whole-repo long-context routing: an architecture-class question
+        # with the repo pinned down (hint or planner filter) skips the
+        # iterative loop and reads the assembled repo in one ring-prefill
+        # pass.  force_level is an explicit caller scope — honor it.
+        if (
+            self.longctx
+            and force_level not in SCOPE_LADDER
+            and state.filters.get("repo")
+            and wants_whole_repo(q)
+        ):
+            state.mode = "longctx"
         state.breadcrumb(
-            "plan", scope=scope, filters=dict(state.filters), attempt=state.attempt,
-            forced=force_level in SCOPE_LADDER or None,
+            "plan", scope=scope, mode=state.mode, filters=dict(state.filters),
+            attempt=state.attempt, forced=force_level in SCOPE_LADDER or None,
         )
 
     def retrieve(self, state: AgentState) -> None:
@@ -328,25 +357,7 @@ class GraphAgent:
         synth_prompt = prompts.synthesis_prompt(
             state.original_query, blocks, overview and has_content
         )
-        if token_cb is None:
-            text = self.llm.complete(synth_prompt)
-        else:
-            # real token streaming into the job event path — the reference
-            # promised this and faked it (qwen_llm.py:149-151 returns the
-            # whole completion as one "stream" chunk)
-            from githubrepostorag_tpu.llm import postprocess_completion
-
-            pieces: list[str] = []
-            for delta in self.llm.stream_complete(synth_prompt):
-                pieces.append(delta)
-                if token_cb is not None:
-                    try:
-                        token_cb(delta)
-                    except Exception:  # noqa: BLE001 - streaming must not kill the run
-                        token_cb = None
-            # same post-processing as the non-streamed path, so the stored
-            # answer is identical whether or not a consumer streamed it
-            text = postprocess_completion(synth_prompt, "".join(pieces))
+        text = self._complete(synth_prompt, token_cb)
 
         # anti-conservative retry (agent_graph.py:489-503)
         if has_content and len(docs) >= 3 and _sounds_conservative(text):
@@ -374,6 +385,94 @@ class GraphAgent:
             "synthesize", final_ctx_blocks=len(blocks), sources_count=len(sources),
             answer_length=len(text), synthesis_issue=state.debug.get("synthesis_issue"),
         )
+
+    def _complete(self, prompt: str, token_cb: Callable[[str], None] | None) -> str:
+        """One completion, streamed into ``token_cb`` when given — real
+        token streaming into the job event path (the reference promised
+        this and faked it: qwen_llm.py:149-151 returns the whole completion
+        as one "stream" chunk)."""
+        if token_cb is None:
+            return self.llm.complete(prompt)
+        from githubrepostorag_tpu.llm import postprocess_completion
+
+        pieces: list[str] = []
+        for delta in self.llm.stream_complete(prompt):
+            pieces.append(delta)
+            if token_cb is not None:
+                try:
+                    token_cb(delta)
+                except Exception:  # noqa: BLE001 - streaming must not kill the run
+                    token_cb = None
+        # same post-processing as the non-streamed path, so the stored
+        # answer is identical whether or not a consumer streamed it
+        return postprocess_completion(prompt, "".join(pieces))
+
+    def synthesize_longctx(
+        self, state: AgentState, token_cb: Callable[[str], None] | None = None
+    ) -> bool:
+        """Whole-repo answer: assemble the planned repo's chunks into one
+        ordered document (retrieval/assembler.py) and synthesize from ALL
+        of it in a single completion — served as one long prompt, which the
+        engine runs through segment-packed ring prefill past
+        SP_PREFILL_THRESHOLD.  Returns False (after resetting the mode and
+        leaving a fallback breadcrumb) when the repo has no chunks or blows
+        the token budget; the caller rejoins the normal RAG loop."""
+        from githubrepostorag_tpu.retrieval import assemble_repo, longctx_token_budget
+
+        repo = state.filters.get("repo", "")
+        budget = longctx_token_budget()
+        try:
+            asm = assemble_repo(
+                self.retrievers.store, repo,
+                namespace=state.filters.get("namespace"), token_budget=budget,
+            )
+        except Exception as exc:  # noqa: BLE001 - mode is an optimization
+            logger.warning("assemble_repo(%s) failed: %s", repo, exc)
+            asm = None
+        if asm is None or asm.truncated:
+            state.mode = "rag"
+            state.breadcrumb(
+                "longctx_fallback",
+                reason="no_chunks" if asm is None else "over_budget",
+                repo=repo, budget=budget,
+                token_estimate=asm.token_estimate if asm else 0,
+            )
+            return False
+
+        state.breadcrumb(
+            "assemble", repo=repo, files=asm.files, chunks=asm.chunks,
+            token_estimate=asm.token_estimate,
+        )
+        text = self._complete(
+            prompts.longctx_synthesis_prompt(state.original_query, repo, asm.text),
+            token_cb,
+        )
+        state.answer = text
+        state.sources = [
+            {
+                "id": 1,
+                "doc_id": f"repo:{repo}",
+                "repo": repo,
+                "module": "",
+                "file_path": "",
+                "scope": "repo",
+                "score": 1.0,
+                "text": truncate(
+                    f"whole repository: {asm.files} files, {asm.chunks} chunks",
+                    SOURCE_TEXT_BUDGET,
+                ),
+            }
+        ]
+        state.debug.update(
+            mode="longctx", longctx_files=asm.files, longctx_chunks=asm.chunks,
+            longctx_tokens=asm.token_estimate, final_scope="repo",
+            sources_count=1, answer_length=len(text),
+        )
+        state.breadcrumb(
+            "synthesize", mode="longctx", files=asm.files,
+            token_estimate=asm.token_estimate, answer_length=len(text),
+        )
+        return True
 
     # ------------------------------------------------------------- driver
 
@@ -412,6 +511,19 @@ class GraphAgent:
                 # worker.py:101-107, SURVEY.md Appendix A) and skips the plan LLM call
                 with span("agent.plan"):
                     self.plan_scope(state, force_level=force_level)
+
+                if state.mode == "longctx":
+                    check_cancel()
+                    with span("agent.longctx"):
+                        served = self.synthesize_longctx(state, token_cb=token_cb)
+                    if served:
+                        run_sp.set_attr("sources", len(state.sources))
+                        return AgentResult(
+                            answer=state.answer or "",
+                            sources=state.sources, debug=state.debug,
+                        )
+                    # fell back (no chunks / over budget): the normal
+                    # loop below runs with the planned scope untouched
 
                 while True:
                     check_cancel()

@@ -85,6 +85,22 @@ def synthesis_prompt(query: str, blocks: list[str], overview: bool) -> str:
     return f"{style}\n\nQuestion: {query}\n\nContext:\n" + "\n\n".join(blocks) + "\n\nAnswer:"
 
 
+def longctx_synthesis_prompt(query: str, repo: str, repo_text: str) -> str:
+    """Whole-repo answer mode: the assembled repository (every ingested
+    chunk, file-ordered — retrieval/assembler.py) IS the context, so the
+    style asks for cross-cutting structure instead of block citations."""
+    style = (
+        f"You are a senior engineer who has just read the ENTIRE {repo} "
+        "repository, reproduced below with ### file headers. Answer from "
+        "the whole codebase: describe how the pieces fit together, citing "
+        "files by path where it helps."
+    )
+    return (
+        f"{style}\n\nQuestion: {query}\n\nRepository {repo}:\n{repo_text}"
+        "\n\nAnswer:"
+    )
+
+
 def encouraging_synthesis_prompt(query: str, blocks: list[str]) -> str:
     style = (
         "You are a helpful engineer. The context below genuinely contains "

@@ -18,6 +18,10 @@ class AgentState:
     query: str
     original_query: str
     scope: str = "repo"
+    mode: str = "rag"  # "rag" = iterative retrieve loop; "longctx" = the
+    # assembled whole repo through the serving stack's ring-prefill path as
+    # ONE prompt (retrieval/assembler.py).  plan_scope picks; an over-budget
+    # or chunk-less repo resets to "rag" and rejoins the normal loop.
     filters: dict[str, str] = field(default_factory=dict)
     attempt: int = 0
     top_k: int | None = None  # per-request result cap (QueryRequest.top_k —

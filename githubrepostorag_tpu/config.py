@@ -96,6 +96,15 @@ class Settings:
     max_rag_attempts: int = field(default_factory=lambda: _env_int("MAX_RAG_ATTEMPTS", 3))
     min_source_nodes: int = field(default_factory=lambda: _env_int("MIN_SOURCE_NODES", 1))
     router_top_k: int = field(default_factory=lambda: _env_int("ROUTER_TOP_K", 5))
+    # whole-repo long-context answer mode: architecture-class questions
+    # skip chunk RAG and feed the assembled repo (retrieval/assembler.py)
+    # through the serving stack's ring-prefill path as ONE prompt
+    agent_longctx: bool = field(default_factory=lambda: _env_bool("AGENT_LONGCTX", True))
+    # token budget for an assembled repo prompt; an over-budget repo falls
+    # back to chunk RAG.  0 = derive from the serving context window,
+    # leaving room for the answer (retrieval/assembler.py)
+    longctx_token_budget: int = field(
+        default_factory=lambda: _env_int("LONGCTX_TOKEN_BUDGET", 0))
 
     # --- Vector store (Cassandra-compatible; in-memory / native store for local) ---
     cassandra_host: str = field(default_factory=lambda: os.getenv("CASSANDRA_HOST", "localhost"))
@@ -189,6 +198,20 @@ class Settings:
     slo_tpot_ms: float = field(default_factory=lambda: _env_float("SLO_TPOT_MS", 100.0))
     slo_deadline_miss_budget: float = field(
         default_factory=lambda: _env_float("SLO_DEADLINE_MISS_BUDGET", 0.05))
+    # the ``longctx`` priority class (whole-repo ring-prefill answers) gets
+    # its own latency objectives: a packed ring pass over hundreds of KLoC
+    # legitimately takes seconds of TTFT that would instantly burn the
+    # interactive budget, while its decode phase is ordinary paged decode
+    # and stays near the interactive TPOT.  These feed the same burn-rate
+    # monitor/admission ladder as every other class (obs/slo.py), so
+    # longctx traffic is throttled and preempted AGAINST, never allowed to
+    # starve the protected class.
+    slo_longctx_ttft_p50_ms: float = field(
+        default_factory=lambda: _env_float("SLO_LONGCTX_TTFT_P50_MS", 15000.0))
+    slo_longctx_ttft_p99_ms: float = field(
+        default_factory=lambda: _env_float("SLO_LONGCTX_TTFT_P99_MS", 45000.0))
+    slo_longctx_tpot_ms: float = field(
+        default_factory=lambda: _env_float("SLO_LONGCTX_TPOT_MS", 150.0))
     # "short,long" rolling windows in seconds for multi-window burn rates
     slo_windows: str = field(default_factory=lambda: os.getenv("SLO_WINDOWS", "60,300"))
     # burn-rate thresholds (SRE canonical 14.4x/6x); a state transition fires
@@ -314,9 +337,28 @@ class Settings:
         default_factory=lambda: _env_bool("PREFILL_PRIORITY", False)
     )
     # prompts at least this long prefill sequence-parallel over the mesh's
-    # sp axis (serving/long_prefill.py); 0 disables
+    # sp axis (serving/long_prefill.py).  An EXPLICIT 0 disables; leaving
+    # the variable unset auto-derives a threshold whenever the mesh has
+    # sp > 1 (serving/engine.derive_sp_prefill_threshold) — the
+    # set/unset distinction rides sp_prefill_threshold_set below
     sp_prefill_threshold: int = field(
         default_factory=lambda: _env_int("SP_PREFILL_THRESHOLD", 0)
+    )
+    sp_prefill_threshold_set: bool = field(
+        default_factory=lambda: os.environ.get("SP_PREFILL_THRESHOLD") is not None
+    )
+    # segment-packed ring prefill: pack every waiting eligible long prompt
+    # into ONE fixed-budget ring pass with per-token segment ids
+    # (serving/long_prefill.ring_prefill_packed); off = one sequence per
+    # ring pass (the longctx A/B baseline)
+    sp_ring_pack: bool = field(
+        default_factory=lambda: _env_bool("SP_RING_PACK", True)
+    )
+    # ring-width buckets kept in the compiled ladder, widest down
+    # (Engine.sp_ring_bucket_ladder); 0 = the full power-of-two ladder
+    # from the threshold bucket to bucketed context_window
+    sp_ring_buckets: int = field(
+        default_factory=lambda: _env_int("SP_RING_BUCKETS", 0)
     )
     # >0: n-gram speculative decoding with drafts of up to k tokens
     # (serving/spec_decode.py) instead of pipelined decode bursts; a latency

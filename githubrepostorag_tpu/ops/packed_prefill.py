@@ -260,3 +260,36 @@ def packed_prefill_attention(
     # row (finite — never committed to KV, never projected to logits)
     flat = out_seg.reshape(r * tq, n_q, hd)
     return flat[jnp.clip(dest, 0, r * tq - 1)]
+
+
+def ring_segment_layout(lens: list[int], width: int, rb: int):
+    """Host-side layout of a segment-packed RING buffer: whole prompts back
+    to back (the ring path always runs from position 0, so unlike the
+    chunked contract above there are no cached prefixes — in-segment index
+    IS the RoPE position).  Returns numpy arrays sized for the compiled
+    ring program:
+
+      seg       [width] int32 — owning segment per token; rb (the fixed
+                segment-row bucket) marks padding
+      positions [width] int32 — restarting at 0 per segment
+      logits_at [rb]    int32 — each segment's last-token index into the
+                flat buffer; rows past len(lens) point at 0 (ignored)
+      starts    [len(lens)] int32 — each segment's first-token offset
+
+    Shared by the engine's packed dispatch and its tests/bench so the
+    buffer layout can never fork between them."""
+    import numpy as np
+
+    assert sum(lens) <= width and len(lens) <= rb
+    seg = np.full((width,), rb, dtype=np.int32)
+    positions = np.zeros((width,), dtype=np.int32)
+    logits_at = np.zeros((rb,), dtype=np.int32)
+    starts = np.zeros((len(lens),), dtype=np.int32)
+    off = 0
+    for i, n in enumerate(lens):
+        seg[off : off + n] = i
+        positions[off : off + n] = np.arange(n)
+        logits_at[i] = off + n - 1
+        starts[i] = off
+        off += n
+    return seg, positions, logits_at, starts

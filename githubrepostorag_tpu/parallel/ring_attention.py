@@ -32,6 +32,7 @@ def ring_attention(
     q: jnp.ndarray,  # [B, S_loc, n_q, hd]  this device's query shard
     k: jnp.ndarray,  # [B, S_loc, n_kv, hd] this device's K shard
     v: jnp.ndarray,  # [B, S_loc, n_kv, hd]
+    seg: jnp.ndarray | None = None,  # [B, S_loc] per-token segment ids
     *,
     axis_name: str,
     axis_size: int,
@@ -41,6 +42,13 @@ def ring_attention(
     device ``i`` owns global positions [i*S_loc, (i+1)*S_loc).  Returns the
     local attention output [B, S_loc, n_q, hd] in q.dtype; softmax runs in
     float32 (MXU-friendly bf16 inputs, f32 accumulation).
+
+    ``seg`` packs many sequences into one ring pass: tokens attend only
+    within their own segment id (and causally, when ``causal``).  The kv-side
+    segment shard rotates around the ring with its K/V block, so every step
+    masks the held block against the resident queries' ids.  Padding tokens
+    carry a sentinel id out of the live range; their rows are garbage and the
+    caller never samples them.
     """
     b, sq, n_q, hd = q.shape
     n_kv = k.shape[2]
@@ -58,6 +66,7 @@ def ring_attention(
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     k_blk, v_blk = k, v
+    kv_seg_blk = seg  # rotates with its K/V block
     for step in range(axis_size):  # static unroll; axis_size is mesh-known
         owner = (my - step) % axis_size  # whose block we hold this step
         kv_pos = owner * sq + jnp.arange(sq)  # [Sk] global positions
@@ -65,9 +74,14 @@ def ring_attention(
         scores = (
             jnp.einsum("bsngh,btnh->bngst", qg, k_blk.astype(jnp.float32)) * scale
         )  # [B, n_kv, g, Sq, Sk]
+        invalid = None  # [B or 1, Sq, Sk]
         if causal:
-            masked = kv_pos[None, :] > q_pos[:, None]  # [Sq, Sk]
-            scores = jnp.where(masked[None, None, None], NEG_INF, scores)
+            invalid = (kv_pos[None, :] > q_pos[:, None])[None]
+        if seg is not None:
+            cross = seg[:, :, None] != kv_seg_blk[:, None, :]  # [B, Sq, Sk]
+            invalid = cross if invalid is None else invalid | cross
+        if invalid is not None:
+            scores = jnp.where(invalid[:, None, None], NEG_INF, scores)
 
         new_m = jnp.maximum(m, scores.max(axis=-1))
         alpha = jnp.exp(m - new_m)  # rescale of previous accumulation
@@ -81,9 +95,14 @@ def ring_attention(
         if step < axis_size - 1:
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)
+            if kv_seg_blk is not None:
+                kv_seg_blk = lax.ppermute(kv_seg_blk, axis_name, perm)
 
-    # with causal masking every query sees at least itself (step 0 covers the
-    # local diagonal), so l > 0 everywhere
+    # with causal masking alone every query sees at least itself (step 0
+    # covers the local diagonal) so l > 0; under segment masking a row can be
+    # fully masked (no kv token shares its id), so guard the divide — the
+    # where is bit-identical to the plain divide wherever l > 0
+    l = jnp.where(l == 0.0, 1.0, l)
     out = acc / l[..., None]  # [B, n_kv, g, Sq, hd]
     out = out.transpose(0, 3, 1, 2, 4).reshape(b, sq, n_q, hd)
     return out.astype(q.dtype)
@@ -98,11 +117,16 @@ def make_ring_attend(
     batch_axis: str = "dp",
     head_axis: str = "tp",
     causal: bool = True,
+    segmented: bool = False,
 ):
     """Build ``attend(q, k, v)`` over *global* [B, S, H, hd] arrays: sequence
     sharded over ``sp``, batch over ``dp``, and heads over ``tp`` when tp
     divides both the Q- and KV-head counts (GQA: otherwise heads stay
     replicated inside the ring so local grouping matches global grouping).
+
+    ``segmented=True`` returns ``attend(q, k, v, seg)`` instead, where ``seg``
+    is [B, S] per-token segment ids sharded like the sequence: many packed
+    sequences share one ring pass, masked to their own segments.
     """
     n = mesh.shape[axis_name]
     tp = mesh.shape.get(head_axis, 1)
@@ -113,7 +137,7 @@ def make_ring_attend(
     spec = P(b_ax, axis_name, h_ax, None)
     body = partial(ring_attention, axis_name=axis_name, axis_size=n, causal=causal)
 
-    if n == 1:
+    if n == 1 and not segmented:
         # degenerate ring: still honour the head/batch layout, skip ppermute
         from githubrepostorag_tpu.ops.attention import dense_attention
 
@@ -121,6 +145,15 @@ def make_ring_attend(
 
     from githubrepostorag_tpu.parallel.compat import shard_map
 
+    if segmented:
+        seg_spec = P(b_ax, axis_name)
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, seg_spec),
+            out_specs=spec,
+            check_vma=False,
+        )
     return shard_map(
         body,
         mesh=mesh,

@@ -2,6 +2,11 @@
 store (the rebuild of the reference's GraphRetriever-per-scope factory,
 rag_worker/src/worker/services/graph_rag_retrievers.py)."""
 
+from githubrepostorag_tpu.retrieval.assembler import (
+    AssembledRepo,
+    assemble_repo,
+    longctx_token_budget,
+)
 from githubrepostorag_tpu.retrieval.coalescer import RetrievalCoalescer
 from githubrepostorag_tpu.retrieval.device_index import DeviceIndexedStore
 from githubrepostorag_tpu.retrieval.live_index import (
@@ -23,6 +28,7 @@ from githubrepostorag_tpu.retrieval.snapshot import (
 )
 
 __all__ = [
+    "AssembledRepo",
     "DeviceIndexedStore",
     "LiveIndexApplier",
     "LiveIndexedStore",
@@ -30,9 +36,11 @@ __all__ = [
     "RetrievedDoc",
     "RetrieverFactory",
     "ScopeRetriever",
+    "assemble_repo",
     "get_live_applier",
     "live_index_payload",
     "load_snapshot",
+    "longctx_token_budget",
     "register_live_applier",
     "restore_replica",
     "save_snapshot",

@@ -116,6 +116,15 @@ async def serve(host: str, port: int) -> None:
     logger.info("tokenizer: %s", type(tokenizer).__name__)
 
     def build_engine(mesh) -> Engine:
+        from githubrepostorag_tpu.serving.engine import derive_sp_prefill_threshold
+
+        sp_threshold = derive_sp_prefill_threshold(
+            sp=mesh.shape.get("sp", 1) if mesh is not None else 1,
+            explicit=s.sp_prefill_threshold,
+            env_set=s.sp_prefill_threshold_set,
+            prefill_chunk=s.prefill_chunk,
+            max_seq_len=s.context_window,
+        )
         return Engine(
             params, cfg,
             max_num_seqs=s.max_num_seqs,
@@ -133,7 +142,9 @@ async def serve(host: str, port: int) -> None:
             kv_host_pool_pages=s.kv_host_pool_pages,
             kv_migrate_burst=s.kv_migrate_burst,
             prefill_priority=s.prefill_priority,
-            sp_prefill_threshold=s.sp_prefill_threshold or None,
+            sp_prefill_threshold=sp_threshold,
+            sp_ring_pack=s.sp_ring_pack,
+            sp_ring_buckets=s.sp_ring_buckets,
             spec_ngram_k=s.spec_ngram_k,
             spec_burst_iters=s.spec_burst_iters,
             draft_params=draft_params,

@@ -346,6 +346,12 @@ class AsyncEngine:
         def on_token(rid: str, token_id: int) -> None:
             self._emit(rid, StreamEvent(type="token", token_id=token_id))
 
+        if priority is None:
+            is_longctx = getattr(self.engine, "is_longctx", None)
+            if callable(is_longctx) and is_longctx(len(prompt_ids)):
+                # ring-prefill-bound request: judged against the longctx
+                # SLO thresholds, throttled/preempted like any batch class
+                priority = "longctx"
         priority = priority or getattr(
             self.engine, "default_priority", "interactive")
         with self._lock:
@@ -423,6 +429,9 @@ class AsyncEngine:
                 "prefix_cache_hit_tokens": getattr(
                     self.engine._allocator, "hit_tokens", 0
                 ),
+                "sp_prefills": getattr(self.engine, "sp_prefills", 0),
+                "sp_ring_segments": getattr(self.engine, "sp_ring_segments", 0),
+                "sp_ring_tokens": getattr(self.engine, "sp_ring_tokens", 0),
                 "spec_proposed": self.engine.spec_proposed,
                 "spec_accepted": self.engine.spec_accepted,
                 # rate-suffixed: MultiAsyncEngine.stats() averages this

@@ -39,6 +39,7 @@ from githubrepostorag_tpu.models.qwen2 import (
     forward_paged,
     forward_paged_packed,
 )
+from githubrepostorag_tpu.ops.packed_prefill import ring_segment_layout
 from githubrepostorag_tpu.ops.sampling import sample_tokens
 from githubrepostorag_tpu.ops.page_migration import (
     gather_pages,
@@ -148,6 +149,32 @@ class _Request:
 from githubrepostorag_tpu.utils import next_bucket as _bucket
 
 
+def derive_sp_prefill_threshold(
+    *,
+    sp: int,
+    explicit: int,
+    env_set: bool,
+    prefill_chunk: int,
+    max_seq_len: int,
+) -> int | None:
+    """Resolve the ring-prefill routing threshold for an engine build.
+
+    ``SP_PREFILL_THRESHOLD`` historically defaulted to 0 — ring prefill
+    stayed dark even on meshes with sp > 1 unless the operator knew the
+    knob.  Now: an EXPLICIT value wins (0 opts out, the historical
+    behavior); unset with sp > 1 auto-derives 4x the prefill chunk — a
+    prompt that would take >= 4 chunked passes amortizes the ring's
+    rotation cost — clamped into [sp, max_seq_len // 2] so tiny test
+    geometries still route something and the threshold never chases the
+    context cap.  Returns None for "disabled" (the Engine convention)."""
+    if sp <= 1:
+        return None
+    if env_set:
+        return explicit if explicit > 0 else None
+    derived = max(sp, min(4 * prefill_chunk, max_seq_len // 2))
+    return derived
+
+
 class Engine:
     def __init__(
         self,
@@ -218,6 +245,20 @@ class Engine:
         # (admissions never stall running streams).
         sp_prefill_threshold: int | None = None,  # prompts this long prefill
         # sequence-parallel over the mesh's sp axis (serving/long_prefill.py)
+        sp_ring_pack: bool = True,  # segment-packed ring prefill: every
+        # waiting eligible long prompt that fits the ring token budget
+        # rides ONE fixed-budget [1, width] ring pass with per-token
+        # segment ids (serving/long_prefill.ring_prefill_packed) instead
+        # of one program per prompt — ring rotation cost amortizes over
+        # full sp shards.  False = the one-sequence-per-pass path (the
+        # longctx A/B baseline).
+        sp_ring_buckets: int = 0,  # SP_RING_BUCKETS: number of ring-width
+        # buckets kept in the compiled ladder, counted from the widest
+        # down (0 = the full power-of-two ladder from the threshold
+        # bucket to bucketed max_seq_len).  Fewer buckets = fewer
+        # compiled ring programs, more padding on small passes;
+        # sp_ring_bucket_ladder() is the single source of truth warmup
+        # and dispatch both read.
         spec_ngram_k: int = 0,  # >0: n-gram speculative decoding with drafts
         # of up to k tokens (serving/spec_decode.py) instead of decode bursts
         spec_burst_iters: int = 0,  # >0 (with spec_ngram_k>0): fuse this many
@@ -381,7 +422,30 @@ class Engine:
         self.transfer_seconds_total = 0.0  # export pack + import unpack
         self.sp_prefill_threshold = sp_prefill_threshold
         self._sp = mesh.shape.get("sp", 1) if mesh is not None else 1
-        self.sp_prefills = 0  # stats: prompts served by the ring-prefill path
+        self.sp_prefills = 0  # stats: ring-prefill passes dispatched
+        self.sp_ring_pack = sp_ring_pack
+        self.sp_ring_bucket_count = max(0, sp_ring_buckets)
+        # fixed segment-row count of the packed ring program: per-segment
+        # arrays (logits_at, presence rows) always dispatch at this many
+        # rows, so the compiled-program set is exactly one per ring width.
+        # Every segment is >= threshold tokens, so the widest pass bounds
+        # how many can ever pack.
+        _thr = max(sp_prefill_threshold or 1, 1)
+        _cap = -(-_bucket(max_seq_len, max_seq_len, minimum=max(1, self._sp))
+                 // max(1, self._sp)) * max(1, self._sp)
+        self.sp_ring_segs = _bucket(
+            max(1, min(max_num_seqs, _cap // _thr)), max_num_seqs, minimum=1
+        )
+        self.sp_ring_segments = 0  # stats: prompts packed into ring passes
+        self.sp_ring_tokens = 0  # stats: real tokens through ring passes
+        self.sp_ring_padding = 0  # stats: unused ring-buffer slots
+        if self._sp > 1 and sp_prefill_threshold is not None:
+            logger.info(
+                "sp prefill: threshold=%d tokens over sp=%d (%s, ladder %s)",
+                sp_prefill_threshold, self._sp,
+                "segment-packed" if sp_ring_pack else "one sequence per pass",
+                self.sp_ring_bucket_ladder(),
+            )
         self.spec_ngram_k = spec_ngram_k
         if spec_burst_iters > 0 and spec_ngram_k <= 0:
             # fail fast on the inert combo:
@@ -1137,18 +1201,25 @@ class Engine:
                 self._allocator.unclaim([req.page_hashes[j]])
             req.pages_registered = j + 1
 
+    def is_longctx(self, prompt_len: int) -> bool:
+        """Would a prompt of this length take the ring-prefill path?  The
+        async driver classifies such requests into the ``longctx`` SLO
+        class (obs/slo.py per-class thresholds) with the SAME conditions
+        the scheduler routes by — one predicate, no drift."""
+        return (
+            self.sp_prefill_threshold is not None
+            and not self._draft_enabled
+            and self._sp > 1
+            and prompt_len >= self.sp_prefill_threshold
+        )
+
     def _sp_eligible(self, req: _Request) -> bool:
         """Long prompts take the sequence-parallel ring-prefill path: the
         whole prompt in one program, attention sharded over sp.  Disabled
         under draft-model speculation: ring prefill writes only target KV,
         and a row whose draft cache is missing its prompt could never
         speculate (the chunked path runs every chunk through both models)."""
-        return (
-            self.sp_prefill_threshold is not None
-            and not self._draft_enabled
-            and self._sp > 1
-            and len(req.prompt) >= self.sp_prefill_threshold
-        )
+        return self.is_longctx(len(req.prompt))
 
     def _commit_first_now(self, others_running: bool) -> bool:
         """Whether a freshly-prefilled row's first token commits with an
@@ -1196,6 +1267,45 @@ class Engine:
                 break
             b *= 2
         return list(dict.fromkeys(out))
+
+    def sp_ring_bucket_ladder(self) -> list[int]:
+        """The exact set of ring-buffer widths the sequence-parallel prefill
+        can dispatch at — one compiled ring program per entry, nothing else
+        (the SP_RING_BUCKETS ladder).  Powers of two from the threshold
+        bucket up to bucketed max_seq_len, each rounded up to a multiple of
+        sp (shard_map needs sp | width); ``sp_ring_buckets`` > 0 keeps only
+        that many from the widest down.  warmup() precompiles every entry
+        and ``_ring_width`` selects from the same list, so live traffic can
+        never reach an unwarmed ring shape."""
+        if self.sp_prefill_threshold is None or self._sp <= 1:
+            return []
+        floor = max(self.sp_prefill_threshold, self._sp, 1)
+        w = 1
+        while w < floor:
+            w *= 2
+        out: list[int] = []
+        cap = _bucket(self.max_seq_len, self.max_seq_len, minimum=self._sp)
+        while True:
+            width = -(-min(w, cap) // self._sp) * self._sp
+            out.append(width)
+            if w >= cap:
+                break
+            w *= 2
+        out = list(dict.fromkeys(out))
+        if self.sp_ring_pack and self.sp_ring_bucket_count > 0:
+            out = out[-self.sp_ring_bucket_count:]
+        return out
+
+    def _ring_width(self, total: int) -> int:
+        """Ring dispatch width for a pass carrying ``total`` real tokens:
+        the smallest ladder entry covering it.  The ONLY width-selection
+        rule for the packed ring path — warmup() iterates the same ladder,
+        so the two can never desynchronize."""
+        ladder = self.sp_ring_bucket_ladder()
+        for w in ladder:
+            if w >= total:
+                return w
+        return ladder[-1]
 
     def _head_need_hashes(self, req: _Request) -> tuple[int, list[bytes]]:
         """Total page need for ``req`` and the chain hashes of the prefix
@@ -1362,9 +1472,21 @@ class Engine:
         if not prefilling:
             return False
         long_reqs = [r for r in prefilling if self._sp_eligible(r) and r.prefill_pos == 0]
-        for req in long_reqs:
-            self._sp_prefill(req, finished)
-            prefilling.remove(req)
+        if long_reqs:
+            if self.sp_ring_pack:
+                # segment-packed: every waiting long prompt that fits the
+                # ring token budget shares ONE pass; the rest keep their
+                # rows and ride the next step's pass (step() re-enters
+                # _try_prefill every iteration, so nothing starves)
+                self._sp_prefill_packed(long_reqs, finished)
+            else:
+                for req in long_reqs:
+                    self._sp_prefill(req, finished)
+            # served or not, ring-bound rows never fall through to the
+            # chunked path below — a leftover would lose its from-position-0
+            # ring contract the moment a chunk advanced its prefill_pos
+            for req in long_reqs:
+                prefilling.remove(req)
         if prefilling:
             self._prefill_batch(prefilling, finished)
         return True
@@ -1713,6 +1835,113 @@ class Engine:
             self._commit_token(req, int(np.asarray(tokens_d)[0]), finished)
         else:
             self._pending_first.append((tokens_d, [(req, 0)]))
+
+    def _sp_prefill_packed(
+        self, reqs: list[_Request], finished: list[GenerationResult]
+    ) -> list[_Request]:
+        """Segment-packed ring prefill: as many waiting long prompts as fit
+        one ring pass, flattened back to back into a [1, width] buffer with
+        per-token segment ids (serving/long_prefill.ring_prefill_packed).
+        Greedy front-pack in admission order — FIFO, no overtaking: packing
+        stops at the first prompt that doesn't fit the widest ladder entry
+        or the fixed segment-row count.  Every segment's K/V commits to its
+        own pages through the shared flat-slot scatter; first tokens sample
+        at the per-segment ``logits_at`` positions in one batched dispatch.
+
+        Shape discipline: width comes from ``_ring_width`` (the
+        SP_RING_BUCKETS ladder) and every per-segment array is fixed at
+        ``sp_ring_segs`` rows, so the compiled set is exactly one ring
+        program per ladder entry — warmup() compiles each, live traffic
+        adds none.  Returns the requests actually served this pass."""
+        from githubrepostorag_tpu.serving.long_prefill import ring_prefill_packed
+
+        others_running = any(
+            r.state == "running" for r in self._row_req.values()
+        )
+        cap = self.sp_ring_bucket_ladder()[-1]
+        rb = self.sp_ring_segs
+        packed: list[_Request] = []
+        total = 0
+        for req in reqs:
+            n = len(req.prompt)
+            if packed and (len(packed) >= rb or total + n > cap):
+                break
+            packed.append(req)
+            total += n
+        width = self._ring_width(total)
+
+        # shared layout (ops/packed_prefill.ring_segment_layout): seg ids with
+        # the rb sentinel, per-segment restarting positions, last-token gather
+        seg, pos_flat, logits_at, starts = ring_segment_layout(
+            [len(req.prompt) for req in packed], width, rb
+        )
+        ids = np.zeros((1, width), dtype=np.int32)
+        pos = pos_flat[None]
+        slots = np.full((width,), -1, dtype=np.int32)
+        for req, off in zip(packed, starts):
+            n = len(req.prompt)
+            ids[0, off : off + n] = req.prompt
+            packed_slot_mapping(
+                self._block_tables[req.row], 0, n, self.page_size, slots, int(off)
+            )
+        self.sp_prefills += 1
+        self.sp_ring_segments += len(packed)
+        self.sp_ring_tokens += total
+        self.sp_ring_padding += width - total
+        self.prefill_tokens += total
+
+        with annotate("engine.sp_prefill_packed"):
+            (logits, self._k_pages, self._v_pages,
+             self._k_scales, self._v_scales) = ring_prefill_packed(
+                self.params, self.cfg,
+                jnp.asarray(ids), jnp.asarray(pos),
+                self._k_pages, self._v_pages,
+                jnp.asarray(slots[None]), jnp.asarray(seg[None]),
+                jnp.asarray(logits_at), self.mesh,
+                k_scales=self._k_scales, v_scales=self._v_scales,
+            )
+
+        # whole prompts into the repetition-penalty presence mask — ONE
+        # batched dispatch at the fixed [rb, max_seq] shape
+        ids_full = np.zeros((rb, self.max_seq_len), dtype=np.int32)
+        rows = np.zeros((rb,), dtype=np.int32)
+        lens = np.zeros((rb,), dtype=np.int32)
+        for i, req in enumerate(packed):
+            n = len(req.prompt)
+            ids_full[i, :n] = req.prompt
+            rows[i] = req.row
+            lens[i] = n
+            req.prefill_pos = req.seq_len = n
+            self._seq_lens[req.row] = n
+            # can't RESUME from the cache, but others can resume from us
+            self._register_full_pages(req)
+        row_d = jnp.asarray(rows)
+        self._presence = _mark_presence_chunks(
+            self._presence, row_d, jnp.asarray(ids_full),
+            jnp.asarray(lens), self.cfg.vocab_size,
+        )
+
+        self._push_sampling()
+        self._rng, key = jax.random.split(self._rng)
+        tokens_d = sample_tokens(
+            logits[:, 0], key,
+            self._temp_d[row_d], self._top_p_d[row_d], self._top_k_d[row_d],
+            self._rep_pen_d[row_d], self._presence[row_d],
+        )
+        live = np.zeros((rb,), dtype=bool)
+        live[: len(packed)] = True
+        safe = jnp.where(jnp.asarray(live), tokens_d, self.cfg.vocab_size)
+        self._presence = _mark_presence_rows(self._presence, row_d, safe)
+        wave = [(req, i) for i, req in enumerate(packed)]
+        for req in packed:
+            req.state = "running"
+        if self._commit_first_now(others_running):
+            tokens = np.asarray(tokens_d)
+            for req, i in wave:
+                self._commit_token(req, int(tokens[i]), finished)
+        else:
+            self._pending_first.append((tokens_d, wave))
+        return packed
 
     def _decode_step(self, finished: list[GenerationResult]) -> None:
         """One decode dispatch: a fused burst of up to ``self.decode_burst``
@@ -2379,22 +2608,21 @@ class Engine:
                            stop_token_ids=()),
         )
         if self.sp_prefill_threshold is not None and self._sp > 1:
-            # precompile the ring-prefill program at every width bucket a
-            # live prompt can hit (ADVICE r02: without this, the first
-            # above-threshold prompt — and each new width — pays a
+            # precompile the ring-prefill program at every ladder width a
+            # live pass can dispatch at (ADVICE r02: without this, the
+            # first above-threshold prompt — and each new width — pays a
             # multi-second-to-minutes XLA compile mid-request, violating
-            # the warmed-shapes discipline stated in _prefill_batch)
-            width = 1
-            while width < max(self.sp_prefill_threshold, self._sp):
-                width *= 2
-            while True:
-                width = min(width, self.max_seq_len)
+            # the warmed-shapes discipline stated in _prefill_batch).
+            # sp_ring_bucket_ladder() is the same list _ring_width (packed)
+            # selects from, and covers the one-sequence path's widths too,
+            # so warmup and dispatch can never desynchronize.  One prompt
+            # per width suffices for the packed program: its per-segment
+            # arrays are fixed at sp_ring_segs rows regardless of how many
+            # segments a live pass actually carries.
+            for width in self.sp_ring_bucket_ladder():
                 n = min(width, self.max_seq_len - 2)  # room for 2 tokens
                 if n >= self.sp_prefill_threshold:
                     self.generate([[1] * n], sp)
-                if width >= self.max_seq_len:
-                    break
-                width *= 2
         if self._draft_enabled:
             # the plain-decode FALLBACK must be warm before it's ever
             # needed: an acceptance collapse mid-request must not pay a

@@ -74,6 +74,15 @@ echo "== preemption A/B (CPU-tiny) =="
 # recompiles across park/resume.
 BENCH_ONLY=preempt JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
 
+echo "== segment-packed ring prefill A/B (CPU-tiny) =="
+# packed vs one-sequence-per-pass ring prefill at equal sp=2 on the same
+# 8-stream mixed-length long-prompt wave: bench_longctx_pair asserts
+# packed aggregate prefill tok/s >= 1.5x the one-seq baseline, both paths
+# (and the unloaded chunked reference) token-identical, zero live-traffic
+# XLA compiles on either ring path, and SLO-plane overhead inside the 2%
+# obs budget.
+BENCH_ONLY=longctx JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -191,6 +191,11 @@ def build_table(records: list[dict], driver_name: str,
         ("Disagg conc256 goodput, fused / disagg (CPU A/B)",
          ["disagg_conc256_cpu_goodput_tok_s_fused",
           "disagg_conc256_cpu_goodput_tok_s_disagg"], "tok/s"),
+        ("Longctx conc8 aggregate prefill, one-seq / packed ring (CPU A/B)",
+         ["longctx_conc8_cpu_agg_prefill_tok_s_seq",
+          "longctx_conc8_cpu_agg_prefill_tok_s_packed"], "tok/s"),
+        ("Longctx conc8 packed-ring speedup at equal sp=2 (CPU A/B)",
+         ["longctx_conc8_cpu_packed_speedup"], "×"),
         ("Qwen2-MoE 16-expert decode, bs=8 (beyond-reference)",
          ["decode_tok_s_per_chip_qwen2-moe-16e_bs8"], "tok/s"),
         ("Qwen2-MoE 16-expert INT8 decode, bs=8",
@@ -216,7 +221,8 @@ def render(root: pathlib.Path = ROOT, driver_name: str | None = None) -> str:
     # metrics a TPU-run BENCH_SUMMARY.json doesn't — appended AFTER the
     # summary records so the committed A/B wins any same-name collision
     for artifact in ("BENCH_retrieval_cpu.json", "BENCH_spec_cpu.json",
-                     "BENCH_kv_tier_cpu.json", "BENCH_disagg_cpu.json"):
+                     "BENCH_kv_tier_cpu.json", "BENCH_disagg_cpu.json",
+                     "BENCH_longctx_cpu.json"):
         path = root / artifact
         if path.exists():
             records += json.loads(path.read_text())["records"]

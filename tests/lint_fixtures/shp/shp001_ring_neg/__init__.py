@@ -1,0 +1,3 @@
+"""SHP001 negative (ring-prefill flavor): the same packed-wave flow, but
+the ring buffer is padded to a ladder width before it reaches the shape
+position — one ring program per ladder rung, any wave composition."""

@@ -1,0 +1,13 @@
+import jax.numpy as jnp
+
+
+def ring_width_ladder(total, cap, minimum=64):
+    w = minimum
+    while w < total:
+        w *= 2
+    return min(w, cap)
+
+
+def ring_buffer(width):
+    width = ring_width_ladder(width, 256)
+    return jnp.zeros((1, width), jnp.int32)

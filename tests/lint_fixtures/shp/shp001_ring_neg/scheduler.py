@@ -1,0 +1,6 @@
+from shp001_ring_neg.pack import ring_buffer
+
+
+def pack_wave(tokens):
+    width = len(tokens)
+    return ring_buffer(width)

@@ -1,0 +1,4 @@
+"""SHP001 positive (ring-prefill flavor): the token count of a packed
+ring wave is len() of request-sized data; sizing the [1, width] ring
+buffer by it compiles a fresh XLA ring program for every distinct wave
+composition.  The source is in scheduler.py, the sink in pack.py."""

@@ -1,0 +1,8 @@
+from shp001_ring_pos.pack import ring_buffer
+
+
+def pack_wave(tokens):
+    # len() of the packed wave's flattened tokens is the taint source: it
+    # changes with every mix of long prompts sharing one ring pass
+    width = len(tokens)
+    return ring_buffer(width)

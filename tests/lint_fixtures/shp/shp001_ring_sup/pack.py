@@ -1,0 +1,5 @@
+import jax.numpy as jnp
+
+
+def ring_buffer(width):
+    return jnp.zeros((1, width), jnp.int32)  # tpulint: disable=SHP001 -- offline repro harness replays one captured wave, single compile

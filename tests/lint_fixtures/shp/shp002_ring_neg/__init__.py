@@ -1,0 +1,3 @@
+"""SHP002 negative (ring-prefill flavor): the same serving class, but
+warmup() precompiles the jitted ring pass at every ladder width the hot
+path can dispatch."""

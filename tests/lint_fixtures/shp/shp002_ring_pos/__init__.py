@@ -1,0 +1,3 @@
+"""SHP002 positive (ring-prefill flavor): a serving class dispatches its
+jitted ring pass at ladder-bucketed widths on the hot path but defines no
+warmup routine — the whole ring ladder compiles under live traffic."""

@@ -1,0 +1,2 @@
+"""SHP002 suppressed (ring-prefill flavor): no-warmup ring class with a
+justified inline suppression on the class line."""

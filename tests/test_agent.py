@@ -181,8 +181,81 @@ def test_filter_list_values_normalized(stack):
         JUDGE: '{"coverage": 0.9, "needs_more": false}',
     })
     res = agent.run("how does the agent work?")
-    retrieves = [t for t in res.debug["turns"] if t["stage"] == "retrieve"]
-    assert retrieves[0]["filters"].get("repo") == "coderag"
+    # the depluralized filter is visible at plan time regardless of whether
+    # the repo filter then routes the run to longctx or the RAG loop
+    plans = [t for t in res.debug["turns"] if t["stage"] == "plan"]
+    assert plans[0]["filters"].get("repo") == "coderag"
+
+
+LONGCTX = r"read the ENTIRE"
+
+
+def test_longctx_mode_whole_repo_answer(stack):
+    # architecture question + repo pinned down -> one assembled-repo
+    # completion, no retrieve/judge loop at all
+    agent, llm = _agent(stack, {
+        PLAN: '{"scope": "repo", "filters": {"repo": "coderag"}}',
+        LONGCTX: "Ingest feeds the worker which drives the agent [worker/agent.py].",
+    })
+    res = agent.run("how do the components of coderag fit together?")
+    assert res.debug.get("mode") == "longctx"
+    assert "feeds the worker" in res.answer
+    assert res.sources == [res.sources[0]] and res.sources[0]["doc_id"] == "repo:coderag"
+    stages = [t["stage"] for t in res.debug["turns"]]
+    assert "assemble" in stages and "retrieve" not in stages
+    # the whole repo went into the one completion
+    longctx_calls = [c for c in llm.calls if "### ingest/controller.py" in c["prompt"]]
+    assert longctx_calls and "### worker/agent.py" in longctx_calls[0]["prompt"]
+
+
+def test_longctx_skipped_for_codey_question(stack):
+    # snippet-smelling questions keep chunk RAG even with a repo filter
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "chunk", "filters": {"repo": "coderag"}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    res = agent.run("how does this function throw an exception?")
+    assert res.debug.get("mode") is None  # never entered longctx
+    assert any(t["stage"] == "retrieve" for t in res.debug["turns"])
+
+
+def test_longctx_over_budget_falls_back_to_rag(stack, monkeypatch):
+    import githubrepostorag_tpu.retrieval as retrieval_pkg
+
+    monkeypatch.setattr(retrieval_pkg, "longctx_token_budget", lambda: 10)
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "repo", "filters": {"repo": "coderag"}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    res = agent.run("what is the overall architecture here?")
+    falls = [t for t in res.debug["turns"] if t["stage"] == "longctx_fallback"]
+    assert falls and falls[0]["reason"] == "over_budget"
+    assert any(t["stage"] == "retrieve" for t in res.debug["turns"])
+    assert res.answer
+
+
+def test_longctx_unknown_repo_falls_back(stack):
+    agent, _ = _agent(stack, {
+        PLAN: '{"scope": "repo", "filters": {"repo": "ghost"}}',
+        JUDGE: '{"coverage": 0.9, "needs_more": false}',
+    })
+    res = agent.run("walk me through the design")
+    falls = [t for t in res.debug["turns"] if t["stage"] == "longctx_fallback"]
+    assert falls and falls[0]["reason"] == "no_chunks"
+    assert res.answer
+
+
+def test_assemble_repo_orders_modules_and_files(stack):
+    from githubrepostorag_tpu.retrieval import assemble_repo
+
+    store, _ = stack
+    asm = assemble_repo(store, "coderag", namespace="default")
+    assert asm is not None and not asm.truncated
+    assert asm.files == 3 and asm.chunks == 3
+    assert asm.token_estimate > 0
+    # ingest module sorts before worker; every file gets a header
+    assert asm.text.index("### ingest/controller.py") < asm.text.index("### worker/agent.py")
+    assert assemble_repo(store, "ghost") is None
 
 
 def test_progress_callback_errors_do_not_kill_run(stack):

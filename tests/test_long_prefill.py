@@ -204,3 +204,129 @@ def test_warmup_skips_ring_prefill_when_disabled(tiny):
     eng = _engine(params, cfg)  # no sp axis, no threshold
     eng.warmup()
     assert eng.sp_prefills == 0
+
+
+# ---- segment-packed ring passes (sp_ring_pack, the default) ---------------
+
+
+def test_packed_ring_multi_segment_token_parity(tiny):
+    """Three long prompts admitted together flatten into ONE segment-packed
+    ring pass; every stream's tokens must match the one-sequence-per-pass
+    ring path AND the chunked single-device path run solo."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(11)
+    lens = (48, 64, 56)  # mixed lengths, all above threshold 40, sum 168
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist() for n in lens]
+    sp = SamplingParams(max_tokens=8, temperature=0.0, stop_token_ids=())
+
+    solo = [_engine(params, cfg).generate([p], sp)[0].output_tokens
+            for p in prompts]
+
+    packed = _sp_engine(params, cfg)
+    got = [r.output_tokens for r in packed.generate(prompts, sp)]
+    assert packed.sp_prefills == 1, "three segments must share one ring pass"
+    assert packed.sp_ring_segments == 3
+    assert got == solo
+
+    seq = _sp_engine(params, cfg, sp_ring_pack=False)
+    got_seq = [r.output_tokens for r in seq.generate(prompts, sp)]
+    assert seq.sp_prefills == 3, "baseline must dispatch one pass per prompt"
+    assert got_seq == solo
+
+
+def test_packed_ring_pool_contents_match_seq(tiny):
+    """The packed pass commits every segment's K/V to the same pages with
+    the same bytes as one-sequence-per-pass ring prefill — same admission
+    order, same allocator decisions, same cache content."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist() for n in (56, 48)]
+    sp = SamplingParams(max_tokens=1, temperature=0.0, stop_token_ids=())
+
+    a = _sp_engine(params, cfg)
+    b = _sp_engine(params, cfg, sp_ring_pack=False)
+    a.generate(prompts, sp)
+    b.generate(prompts, sp)
+    assert a.sp_prefills == 1 and b.sp_prefills == 2
+    np.testing.assert_allclose(np.asarray(a._k_pages), np.asarray(b._k_pages),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a._v_pages), np.asarray(b._v_pages),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_packed_ring_kv_quant_parity(tiny):
+    """kv_quant composes with segment packing: both ring flavors compute
+    the whole prompt full-precision and quantize once at commit with the
+    same first-write-fixes-the-scale rule, so decoded tokens must match
+    exactly and the int8 page bytes within rounding."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist() for n in (48, 64)]
+    sp = SamplingParams(max_tokens=10, temperature=0.0, stop_token_ids=())
+
+    a = _sp_engine(params, cfg, kv_quant=True)
+    b = _sp_engine(params, cfg, kv_quant=True, sp_ring_pack=False)
+    got_a = [r.output_tokens for r in a.generate(prompts, sp)]
+    got_b = [r.output_tokens for r in b.generate(prompts, sp)]
+    assert a.sp_prefills == 1
+    assert got_a == got_b
+    for pa, pb in ((a._k_pages, b._k_pages), (a._v_pages, b._v_pages)):
+        diff = np.abs(np.asarray(pa, np.int32) - np.asarray(pb, np.int32))
+        assert diff.max() <= 2, f"pages diverged beyond rounding: {diff.max()}"
+
+
+def test_packed_ring_token_budget_splits_passes(tiny):
+    """A wave over the widest ladder width front-packs FIFO: the pass stops
+    at the first prompt that doesn't fit and the leftover rides the NEXT
+    step's pass — nothing starves, tokens match the solo runs."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(14)
+    lens = (120, 120, 112)  # 240 fits the 256-wide cap, the third doesn't
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist() for n in lens]
+    sp = SamplingParams(max_tokens=6, temperature=0.0, stop_token_ids=())
+
+    solo = [_engine(params, cfg).generate([p], sp)[0].output_tokens
+            for p in prompts]
+    eng = _sp_engine(params, cfg)
+    got = [r.output_tokens for r in eng.generate(prompts, sp)]
+    assert eng.sp_prefills == 2, "240-token pass then the 112-token leftover"
+    assert eng.sp_ring_segments == 3
+    assert got == solo
+
+
+def test_packed_ring_mixed_with_short_chunked_rows(tiny):
+    """Long prompts pack into a ring pass while a short prompt in the SAME
+    admission wave rides the chunked path; all match their solo runs."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(16)
+    long_a = rng.integers(0, cfg.vocab_size, 48).tolist()
+    long_b = rng.integers(0, cfg.vocab_size, 44).tolist()
+    short = [3, 1, 4, 1, 5]
+    sp = SamplingParams(max_tokens=6, temperature=0.0, stop_token_ids=())
+
+    solo = [_engine(params, cfg).generate([p], sp)[0].output_tokens
+            for p in (long_a, long_b, short)]
+    eng = _sp_engine(params, cfg)
+    got = [r.output_tokens for r in eng.generate([long_a, long_b, short], sp)]
+    assert eng.sp_prefills == 1 and eng.sp_ring_segments == 2
+    assert got == solo
+
+
+def test_packed_ring_registers_prefix_for_chunked_followers(tiny):
+    """Packed-ring segments publish their pages like the one-sequence path:
+    a later short prompt sharing a packed segment's prefix resumes from
+    the cache on the chunked path."""
+    _, params, cfg = tiny
+    rng = np.random.default_rng(15)
+    prefix = rng.integers(0, cfg.vocab_size, 24).tolist()
+    long_a = prefix + rng.integers(0, cfg.vocab_size, 24).tolist()  # 48
+    long_b = rng.integers(0, cfg.vocab_size, 56).tolist()
+    short = prefix + [5, 6]  # 26 tokens, chunked path
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+
+    eng = _sp_engine(params, cfg)
+    eng.generate([long_a, long_b], sp)
+    assert eng.sp_prefills == 1 and eng.sp_ring_segments == 2
+    expected = _engine(params, cfg).generate([short], sp)[0].output_tokens
+    assert eng.generate([short], sp)[0].output_tokens == expected
+    assert eng._allocator.hit_tokens == 24  # 3 pages resumed from the cache

@@ -235,6 +235,33 @@ def test_monitor_ttft_and_tpot_objectives(monkeypatch):
     assert by_name["tpot"]["events"] == 5 and by_name["tpot"]["bad"] == 5
 
 
+def test_monitor_longctx_class_has_relaxed_thresholds(monkeypatch):
+    """A 5s TTFT is a hard interactive miss but comfortably inside the
+    longctx objectives — same monitor, per-class threshold override."""
+    monkeypatch.setenv("SLO_WINDOWS", "1,5")
+    monkeypatch.setenv("SLO_TTFT_P99_MS", "1000")
+    monkeypatch.setenv("SLO_LONGCTX_TTFT_P99_MS", "45000")
+    reload_settings()
+    mon = SLOMonitor("m4")
+    t0 = 5000.0
+    for i in range(5):
+        mon.observe("interactive", ttft_s=5.0, now=t0 + 0.05 * i)
+        mon.observe("longctx", ttft_s=5.0, now=t0 + 0.05 * i)
+    payload = mon.payload(now=t0 + 0.3)
+    rows = {(r["objective"], r["klass"]): r for r in payload["objectives"]}
+    assert rows[("ttft_p99", "interactive")]["state"] == "critical"
+    assert rows[("ttft_p99", "longctx")]["state"] == "ok"
+    assert rows[("ttft_p99", "longctx")]["bad"] == 0
+
+
+def test_slo_payload_config_includes_longctx_thresholds():
+    plane = get_slo_plane()
+    cfg = plane.slo_payload()["config"]
+    assert cfg["longctx_ttft_p50_ms"] > cfg["ttft_p50_ms"]
+    assert cfg["longctx_ttft_p99_ms"] > cfg["ttft_p99_ms"]
+    assert cfg["longctx_tpot_ms"] >= cfg["tpot_ms"]
+
+
 # ------------------------------------------------------------ SLO plane
 
 

@@ -200,6 +200,57 @@ def test_shp001_compact_suppressed_is_silenced_with_justification():
     assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
 
 
+# Segment-packed ring prefill extends both SHP alphabets: the [1, width]
+# ring buffer must be sized by the SP_RING_BUCKETS ladder, not by the raw
+# token count of whichever long prompts packed into the wave
+# (serving/engine.py routes every packed pass through _ring_width /
+# sp_ring_bucket_ladder for exactly this reason — one compiled ring
+# program per ladder entry, any wave composition), and a class dispatching
+# ring passes at ladder widths must precompile them in warmup.
+
+def test_shp001_ring_positive_catches_wave_sized_buffer():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_ring_pos"])
+    hits = [f for f in findings if f.rule == "SHP001" and not f.suppressed]
+    assert hits, "wave-token-sized ring buffer escaped the taint pass"
+    (hit,) = hits
+    assert "len(tokens)" in hit.taint_chain[0]
+    assert "scheduler.py" in hit.taint_chain[0]  # source module
+    assert "pack.py" in hit.taint_chain[-1]  # sink module
+
+
+def test_shp001_ring_negative_is_silent():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_ring_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_shp001_ring_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_ring_sup"])
+    hits = [f for f in findings if f.rule == "SHP001"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
+def test_shp002_ring_positive_flags_unwarmed_ring_ladder():
+    findings, _ = run_paths([SHP_FIXTURES / "shp002_ring_pos"])
+    hits = [f for f in findings if f.rule == "SHP002" and not f.suppressed]
+    assert any("RingPrefillServer" in f.message for f in hits), (
+        "ring class with no warmup escaped SHP002")
+
+
+def test_shp002_ring_negative_is_silent():
+    findings, _ = run_paths([SHP_FIXTURES / "shp002_ring_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_shp002_ring_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([SHP_FIXTURES / "shp002_ring_sup"])
+    hits = [f for f in findings if f.rule == "SHP002"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
 # ------------------------------------------------------- planted regressions
 # Mutation tests against the REAL tree: re-introduce the two classes of bug
 # the shapeflow pass exists to catch, and prove it catches them.
