@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Schema gate for the archived tpulint artifacts.
+
+CI consumers (dashboards, code-scanning upload) pin the v4 JSON shape and
+SARIF 2.1.0 ruleIndex invariants; this script fails the build the moment
+either artifact drifts — a silently renamed field or an unsorted SARIF
+rule table would otherwise break consumers long after the producing PR
+merged.
+
+Usage: check_tpulint_schema.py [tpulint.json] [tpulint.sarif]
+(defaults: artifacts/tpulint.json, artifacts/tpulint.sarif)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+EXPECTED_JSON_VERSION = 4
+FINDING_FIELDS = {
+    "path", "line", "col", "rule", "message", "suppressed",
+    "justification", "qualname", "baselined", "witness",
+}
+STATS_FIELDS = {"files", "findings", "unsuppressed", "suppressed", "baselined"}
+PASS_KEYS = {"graph_build", "per_file", "wpa", "shapeflow", "spmdflow"}
+SPD_RULES = {"SPD001", "SPD002", "SPD003", "SPD004", "SPD005"}
+
+
+def fail(msg: str) -> None:
+    print(f"check_tpulint_schema: FAIL: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def check_json(path: Path) -> None:
+    payload = json.loads(path.read_text(encoding="utf-8"))
+    if payload.get("version") != EXPECTED_JSON_VERSION:
+        fail(f"{path}: version {payload.get('version')!r}, "
+             f"expected {EXPECTED_JSON_VERSION}")
+    stats = payload.get("stats", {})
+    missing = STATS_FIELDS - set(stats)
+    if missing:
+        fail(f"{path}: stats missing {sorted(missing)}")
+    seconds = stats.get("pass_seconds")
+    if not isinstance(seconds, dict) or set(seconds) != PASS_KEYS:
+        fail(f"{path}: stats.pass_seconds must carry exactly "
+             f"{sorted(PASS_KEYS)}, got {seconds!r}")
+    if not all(isinstance(v, (int, float)) and v >= 0
+               for v in seconds.values()):
+        fail(f"{path}: non-numeric pass_seconds entries: {seconds!r}")
+    for entry in payload.get("findings", []):
+        if set(entry) != FINDING_FIELDS:
+            fail(f"{path}: finding fields {sorted(entry)} != "
+                 f"{sorted(FINDING_FIELDS)}")
+        if entry["witness"] is not None and not (
+                isinstance(entry["witness"], list)
+                and all(isinstance(s, str) for s in entry["witness"])):
+            fail(f"{path}: witness must be null or a list of step strings")
+    rules = payload.get("rules", {})
+    missing_rules = SPD_RULES - set(rules)
+    if missing_rules:
+        fail(f"{path}: rules map missing {sorted(missing_rules)}")
+
+
+def check_sarif(path: Path) -> None:
+    payload = json.loads(path.read_text(encoding="utf-8"))
+    if payload.get("version") != "2.1.0":
+        fail(f"{path}: SARIF version {payload.get('version')!r}")
+    runs = payload.get("runs", [])
+    if len(runs) != 1:
+        fail(f"{path}: expected exactly one run, got {len(runs)}")
+    driver = runs[0].get("tool", {}).get("driver", {})
+    rules = driver.get("rules", [])
+    ids = [r.get("id") for r in rules]
+    if ids != sorted(ids):
+        fail(f"{path}: driver.rules not sorted by id")
+    if SPD_RULES - set(ids):
+        fail(f"{path}: driver.rules missing {sorted(SPD_RULES - set(ids))}")
+    for result in runs[0].get("results", []):
+        idx = result.get("ruleIndex")
+        if not isinstance(idx, int) or not (0 <= idx < len(rules)):
+            fail(f"{path}: result has bad ruleIndex {idx!r}")
+        if rules[idx]["id"] != result.get("ruleId"):
+            fail(f"{path}: ruleIndex {idx} points at "
+                 f"{rules[idx]['id']!r}, result says {result.get('ruleId')!r}")
+
+
+def main(argv: list[str]) -> None:
+    json_path = Path(argv[1]) if len(argv) > 1 else REPO / "artifacts" / "tpulint.json"
+    sarif_path = Path(argv[2]) if len(argv) > 2 else REPO / "artifacts" / "tpulint.sarif"
+    for p in (json_path, sarif_path):
+        if not p.exists():
+            fail(f"{p} does not exist (run the tpulint artifact steps first)")
+    check_json(json_path)
+    check_sarif(sarif_path)
+    print(f"check_tpulint_schema: OK ({json_path.name} v{EXPECTED_JSON_VERSION}, "
+          f"{sarif_path.name} 2.1.0, SPD001-005 registered)")
+
+
+if __name__ == "__main__":
+    main(sys.argv)

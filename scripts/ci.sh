@@ -8,10 +8,10 @@ echo "== tpulint =="
 make lint
 
 echo "== tpulint whole-program JSON artifact =="
-# machine-readable findings (schema v3: incl. suppressed + baselined and
-# per-finding SHP001 taint_chain witnesses) for CI consumers; the baseline
-# gate itself already ran inside `make lint`, so an unbaselined SHP/WPA/TPU
-# finding has already failed the build by this point
+# machine-readable findings (schema v4: incl. suppressed + baselined,
+# per-finding SHP/SPD witness chains, and per-pass wall times) for CI
+# consumers; the baseline gate itself already ran inside `make lint`, so an
+# unbaselined SPD/SHP/WPA/TPU finding has already failed the build by now
 mkdir -p artifacts
 python -m tools.tpulint githubrepostorag_tpu tests \
     --exclude tests/lint_fixtures --baseline tools/tpulint/baseline.json \
@@ -25,6 +25,11 @@ python -m tools.tpulint githubrepostorag_tpu tests \
     --exclude tests/lint_fixtures --baseline tools/tpulint/baseline.json \
     --format sarif > artifacts/tpulint.sarif \
     || { echo "tpulint SARIF pass failed (exit $?)"; exit 1; }
+
+echo "== tpulint artifact schema gate =="
+# pin the v4 JSON shape (witness field, pass_seconds stats) and the SARIF
+# ruleIndex invariants the code-scanning upload depends on
+python scripts/check_tpulint_schema.py artifacts/tpulint.json artifacts/tpulint.sarif
 
 echo "== /debug/traces schema =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_traces_schema.py

@@ -1,0 +1,3 @@
+"""SPD001 negative: every collective names an axis the mesh binds,
+including one resolved through an axis_name= parameter default and a
+partial() binding."""

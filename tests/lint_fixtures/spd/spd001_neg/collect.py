@@ -1,0 +1,15 @@
+from functools import partial
+
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _sum_body(x, axis_name="dp"):
+    return lax.psum(x, axis_name)
+
+
+def gather_stats(mesh, x):
+    f = shard_map(partial(_sum_body, axis_name="tp"), mesh,
+                  in_specs=(P(),), out_specs=P())
+    return f(x)

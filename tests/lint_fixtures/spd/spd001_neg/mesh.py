@@ -1,0 +1,7 @@
+from jax.sharding import Mesh
+
+AXIS_NAMES = ("dp", "tp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXIS_NAMES)

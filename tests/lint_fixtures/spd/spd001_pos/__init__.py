@@ -1,0 +1,3 @@
+"""SPD001 positive: the shard_map body psums over an axis the mesh does
+not bind — the axis universe comes from mesh.py, the collective sits in
+collect.py, so only the cross-module pass can connect them."""

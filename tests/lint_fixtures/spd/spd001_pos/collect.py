@@ -1,0 +1,12 @@
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _sum_body(x):
+    return lax.psum(x, "pp")
+
+
+def gather_stats(mesh, x):
+    f = shard_map(_sum_body, mesh, in_specs=(P(),), out_specs=P())
+    return f(x)

@@ -1,0 +1,2 @@
+"""SPD001 suppressed: same hazard as the positive, silenced with a
+justified directive on the collective line."""

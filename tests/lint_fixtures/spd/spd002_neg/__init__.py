@@ -1,0 +1,3 @@
+"""SPD002 negative: every donation is followed only by the rebinding
+idiom (`pool = f(pool)`) or by no further read; a branch that donates
+rebinds on both arms before the next read."""

@@ -1,0 +1,14 @@
+from spd002_neg.ops import update_pool
+
+
+def step(pool, delta):
+    pool = update_pool(pool, delta)
+    return pool.sum()
+
+
+def branchy(pool, delta, fast):
+    if fast:
+        pool = update_pool(pool, delta)
+    else:
+        pool = update_pool(pool, delta * 2)
+    return pool * 2

@@ -1,0 +1,4 @@
+"""SPD002 positive: a buffer donated to a jitted call (defined in
+ops.py) is read again in engine.py — once directly, once through a
+helper that consumes its parameter, so the witness must chain the
+helper hop."""

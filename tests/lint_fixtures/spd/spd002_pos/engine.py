@@ -1,0 +1,15 @@
+from spd002_pos.ops import update_pool
+
+
+def step(pool, delta):
+    new_pool = update_pool(pool, delta)
+    return pool.sum() + new_pool
+
+
+def _flush(pool, delta):
+    update_pool(pool, delta)
+
+
+def drive(pool, delta):
+    _flush(pool, delta)
+    return pool * 2

@@ -1,0 +1,8 @@
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update_pool(pool, delta):
+    return pool + delta

@@ -1,0 +1,2 @@
+"""SPD002 suppressed: the stale read is silenced with a justified
+directive on the read line."""

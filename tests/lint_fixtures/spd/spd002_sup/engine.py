@@ -1,0 +1,6 @@
+from spd002_sup.ops import update_pool
+
+
+def step(pool, delta):
+    new_pool = update_pool(pool, delta)
+    return pool.sum() + new_pool  # tpulint: disable=SPD002 -- donation is a no-op on the CPU smoke path this helper serves

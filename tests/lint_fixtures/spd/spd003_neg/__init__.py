@@ -1,0 +1,3 @@
+"""SPD003 negative: the psum-reduced value returns under a replicated
+spec, the partitioned passthrough keeps its axis in out_specs, and a
+branch-reduced value is returned inside the reduced arm only."""

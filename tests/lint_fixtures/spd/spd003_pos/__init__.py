@@ -1,0 +1,3 @@
+"""SPD003 positive: one body psums over tp but out_specs still
+partitions tp (the replicated result is re-scattered); a second body
+returns an unreduced per-shard accumulator under a replicated spec."""

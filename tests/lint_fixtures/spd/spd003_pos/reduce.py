@@ -1,0 +1,31 @@
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp", "tp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def _reduce_body(x):
+    total = lax.psum(x, "tp")
+    return total
+
+
+def all_reduce(mesh, x):
+    f = shard_map(_reduce_body, mesh,
+                  in_specs=(P(None, "tp"),), out_specs=P(None, "tp"))
+    return f(x)
+
+
+def _accum_body(x):
+    acc = x * 2
+    return acc
+
+
+def broken_mean(mesh, x):
+    f = shard_map(_accum_body, mesh,
+                  in_specs=(P(None, "tp"),), out_specs=P())
+    return f(x)

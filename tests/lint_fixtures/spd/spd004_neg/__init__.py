@@ -1,0 +1,2 @@
+"""SPD004 negative: canonical modular cyclic shift, plus an explicit
+constant permutation that covers both ranks exactly once."""

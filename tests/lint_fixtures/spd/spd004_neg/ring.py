@@ -1,0 +1,18 @@
+from jax import lax
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def rotate(x, axis_size):
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    return lax.ppermute(x, "sp", perm=perm)
+
+
+def swap_pair(x):
+    perm = [(0, 1), (1, 0)]
+    return lax.ppermute(x, "sp", perm=perm)

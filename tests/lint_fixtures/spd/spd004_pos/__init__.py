@@ -1,0 +1,2 @@
+"""SPD004 positive: the ring permutation misses the % axis_size wrap,
+so the last rank's destination falls off the ring."""

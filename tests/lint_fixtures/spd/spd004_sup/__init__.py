@@ -1,0 +1,2 @@
+"""SPD004 suppressed: the unwrapped shift is silenced with a justified
+directive on the ppermute line the finding anchors to."""

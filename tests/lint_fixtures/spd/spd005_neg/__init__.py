@@ -1,0 +1,3 @@
+"""SPD005 negative: the table arrives through the body's arguments with
+its own in_specs entry; the closed-over module binding is a plain float
+scale, not a device array."""

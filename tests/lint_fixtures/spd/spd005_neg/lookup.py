@@ -1,0 +1,20 @@
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXES = ("dp",)
+
+_SCALE = 1.0 / 1024.0
+
+
+def make_mesh(devices):
+    return Mesh(devices, AXES)
+
+
+def _lookup_body(table, idx):
+    return table[idx] * _SCALE
+
+
+def lookup(mesh, table, idx):
+    f = shard_map(_lookup_body, mesh,
+                  in_specs=(P(), P("dp")), out_specs=P("dp"))
+    return f(table, idx)

@@ -1,0 +1,3 @@
+"""SPD005 positive: the shard_map body indexes a module-level
+jnp.arange table through its closure — the trace captures it as a
+constant and every shard materializes a full replicated copy."""

@@ -1,0 +1,2 @@
+"""SPD005 suppressed: the closed-over table read is silenced with a
+justified directive on the read line."""

@@ -1,0 +1,92 @@
+"""Donation contract on the KV migrate path.
+
+``scatter_pages`` is jitted with ``donate_argnums=(0, 1, 4, 5)``: the
+device pools (and quantization scales) handed in are *donated* — XLA may
+reuse their buffers for the outputs, so the caller must rebind from the
+returned tuple and never touch the originals again.  The whole-program
+linter (SPD002) proves every call site in the tree follows that contract
+statically; this test pins it dynamically, so a future edit that drops
+the rebinding (``_, _, _, _ = scatter_pages(...)``) fails a behavioral
+test as well as the lint gate.
+
+On CPU donation is allowed to be a no-op (the runtime may keep the input
+buffer alive), so the deletion probe is opportunistic: we only assert
+that *if* the runtime did consume the input, reading it raises — and
+that the returned pools are correct either way.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from githubrepostorag_tpu.ops.page_migration import gather_pages, scatter_pages
+
+
+def _pools(seed=11):
+    L, n_kv, P, ps, hd, nb = 2, 2, 6, 4, 8, 4
+    rng = np.random.default_rng(seed)
+    k0 = jnp.asarray(rng.standard_normal((L, n_kv, P, ps, hd)), jnp.float32)
+    v0 = jnp.asarray(rng.standard_normal((L, n_kv, P, ps, hd)), jnp.float32)
+    pk = jnp.asarray(rng.standard_normal((L, n_kv, nb, ps, hd)), jnp.float32)
+    pv = jnp.asarray(rng.standard_normal((L, n_kv, nb, ps, hd)), jnp.float32)
+    idx = jnp.asarray(np.array([4, 1, -1, -1], np.int32))
+    return k0, v0, pk, pv, idx
+
+
+def test_scatter_pages_rebinding_contract_carries_the_burst():
+    """The migrate path must rebind the pools from scatter_pages' return
+    value: the returned arrays — not the donated inputs — are the ones
+    that carry the fault-in burst."""
+    k0, v0, pk, pv, idx = _pools()
+    k_ref, v_ref = np.asarray(k0), np.asarray(v0)
+
+    k1, v1, _, _ = scatter_pages(k0, v0, idx, pk, v_vals=pv)
+
+    # the rebound pools carry the burst at the real rows...
+    np.testing.assert_array_equal(np.asarray(k1[:, :, 4]), np.asarray(pk[:, :, 0]))
+    np.testing.assert_array_equal(np.asarray(v1[:, :, 4]), np.asarray(pv[:, :, 0]))
+    np.testing.assert_array_equal(np.asarray(k1[:, :, 1]), np.asarray(pk[:, :, 1]))
+    # ...and every untouched page survives the buffer reuse intact
+    for p in [0, 2, 3, 5]:
+        np.testing.assert_array_equal(np.asarray(k1[:, :, p]), k_ref[:, :, p])
+        np.testing.assert_array_equal(np.asarray(v1[:, :, p]), v_ref[:, :, p])
+
+
+def test_scatter_pages_donated_inputs_are_dead_after_the_call():
+    """If the runtime honored the donation, the input pools are deleted
+    and any read raises — exactly the hazard SPD002 flags statically.
+    Donation may legally be a no-op (CPU often keeps the buffer), so a
+    still-live input only has to still hold its pre-call contents."""
+    k0, v0, pk, pv, idx = _pools(seed=12)
+    k_ref = np.asarray(k0)
+
+    k1, v1, _, _ = scatter_pages(k0, v0, idx, pk, v_vals=pv)
+    jax.block_until_ready((k1, v1))
+
+    for donated in (k0, v0):
+        if donated.is_deleted():
+            with pytest.raises(RuntimeError):
+                np.asarray(donated)
+    if not k0.is_deleted():
+        # no-op donation: the original is untouched, the burst only
+        # exists in the rebound result
+        np.testing.assert_array_equal(np.asarray(k0), k_ref)
+        assert not np.array_equal(np.asarray(k1[:, :, 4]), k_ref[:, :, 4])
+
+
+def test_gather_pages_does_not_consume_its_inputs():
+    """gather_pages is jitted WITHOUT donate_argnums: the pools stay
+    live and readable after the call — the read side of a migration
+    burst must not invalidate the resident pools."""
+    k0, v0, pk, pv, idx = _pools(seed=13)
+    k1, v1, _, _ = scatter_pages(k0.copy(), v0.copy(), idx, pk, v_vals=pv)
+
+    gk, gv, _, _ = gather_pages(k1, v1, idx)
+    jax.block_until_ready((gk, gv))
+
+    assert not k1.is_deleted() and not v1.is_deleted()
+    # real rows round-trip, and the pools are still readable afterwards
+    np.testing.assert_array_equal(np.asarray(gk[:, :, 0]), np.asarray(pk[:, :, 0]))
+    np.testing.assert_array_equal(np.asarray(gv[:, :, 0]), np.asarray(pv[:, :, 0]))
+    np.testing.assert_array_equal(np.asarray(k1[:, :, 4]), np.asarray(pk[:, :, 0]))

@@ -37,11 +37,13 @@ from tools.tpulint.rules import RULES  # noqa: E402
 FIXTURES = REPO / "tests" / "lint_fixtures"
 WPA_FIXTURES = FIXTURES / "wpa"
 SHP_FIXTURES = FIXTURES / "shp"
+SPD_FIXTURES = FIXTURES / "spd"
 RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
             "TPU007", "ASY001", "ASY002", "OBS001", "OBS002"]
 WPA_RULE_IDS = ["WPA001", "WPA002", "WPA003", "WPA004"]
 SHP_RULE_IDS = ["SHP001", "SHP002", "SHP003", "SHP004"]
-ALL_RULE_IDS = RULE_IDS + WPA_RULE_IDS + SHP_RULE_IDS
+SPD_RULE_IDS = ["SPD001", "SPD002", "SPD003", "SPD004", "SPD005"]
+ALL_RULE_IDS = RULE_IDS + WPA_RULE_IDS + SHP_RULE_IDS + SPD_RULE_IDS
 
 
 # ------------------------------------------------------------------ registry
@@ -251,6 +253,104 @@ def test_shp002_ring_suppressed_is_silenced_with_justification():
     assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
 
 
+# The SPD (spmdflow) fixtures follow the same convention: each rule has a
+# pos/neg/sup mini-package.  The SPD001 positive splits the mesh
+# construction and the bad collective across modules; the SPD002 positive
+# routes one donation through a helper so the witness must chain the hop.
+
+@pytest.mark.parametrize("rule_id", SPD_RULE_IDS)
+def test_spd_positive_fixture_fires(rule_id):
+    findings, _ = run_paths([SPD_FIXTURES / f"{rule_id.lower()}_pos"])
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, f"{rule_id} did not fire on its positive fixture package"
+    assert all(not f.suppressed for f in hits)
+    assert [f.rule for f in findings] == [rule_id] * len(hits)
+
+
+@pytest.mark.parametrize("rule_id", SPD_RULE_IDS)
+def test_spd_negative_fixture_is_silent(rule_id):
+    findings, _ = run_paths([SPD_FIXTURES / f"{rule_id.lower()}_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+@pytest.mark.parametrize("rule_id", SPD_RULE_IDS)
+def test_spd_suppressed_fixture_is_silenced_with_justification(rule_id):
+    findings, _ = run_paths([SPD_FIXTURES / f"{rule_id.lower()}_sup"])
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
+def test_spd001_witness_names_the_unbound_axis_and_known_axes():
+    findings, _ = run_paths([SPD_FIXTURES / "spd001_pos"])
+    (hit,) = [f for f in findings if f.rule == "SPD001"]
+    assert "'pp'" in hit.message and "dp" in hit.message and "tp" in hit.message
+    assert hit.taint_chain
+    assert "psum" in hit.taint_chain[-1]
+    assert "collect.py" in hit.taint_chain[-1]
+
+
+def test_spd002_witness_chains_the_helper_hop():
+    """The drive->_flush->jit donation must carry every hop: the helper
+    that consumed the parameter, the jitted callee that donated it, and
+    the stale read, each with a file:line anchor."""
+    findings, _ = run_paths([SPD_FIXTURES / "spd002_pos"])
+    hits = [f for f in findings if f.rule == "SPD002"]
+    assert len(hits) == 2
+    chained = [f for f in hits if any("_flush" in s for s in (f.taint_chain or []))]
+    (via_helper,) = chained
+    assert len(via_helper.taint_chain) >= 3
+    assert "update_pool" in " ".join(via_helper.taint_chain)
+    assert "read again" in via_helper.taint_chain[-1]
+    for step in via_helper.taint_chain:
+        assert ":" in step and "[" in step  # every step carries file:line
+
+
+def test_spd_rules_have_stale_suppression_sweep_and_unknown_exit(tmp_path):
+    """LNT002 covers SPD directives: a justified disable that matches no
+    SPD finding is swept; a misspelled SPD id is LNT001."""
+    (tmp_path / "mod.py").write_text(
+        "def fine(pool):\n"
+        "    # tpulint: disable=SPD002 -- historical; the donation moved behind a rebind\n"
+        "    return pool\n"
+    )
+    findings, _ = run_paths([tmp_path])
+    assert [f.rule for f in findings] == [RULE_STALE_SUPPRESSION]
+    (tmp_path / "mod.py").write_text(
+        "def fine(pool):\n"
+        "    # tpulint: disable=SPD999 -- no such rule\n"
+        "    return pool\n"
+    )
+    findings, _ = run_paths([tmp_path])
+    assert RULE_UNKNOWN_RULE in {f.rule for f in findings}
+
+
+def test_cli_unknown_spd_suppression_exits_3(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(
+        "def fine(pool):\n"
+        "    # tpulint: disable=SPD999 -- misspelled id\n"
+        "    return pool\n"
+    )
+    assert _run_cli(str(target)).returncode == 3
+
+
+def test_spd_baseline_roundtrip(tmp_path):
+    """--write-baseline fingerprints SPD findings like every other rule,
+    and the baselined run exits clean."""
+    baseline = tmp_path / "baseline.json"
+    target = "tests/lint_fixtures/spd/spd001_pos"
+    assert _run_cli(target).returncode == 1
+    assert _run_cli(target, "--write-baseline", str(baseline)).returncode == 0
+    payload = json.loads(baseline.read_text())
+    assert any(fp.startswith("SPD001::") for fp in payload["fingerprints"])
+    proc = _run_cli(target, "--baseline", str(baseline), "--format", "json")
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout)
+    assert out["stats"]["baselined"] > 0
+
+
 # ------------------------------------------------------- planted regressions
 # Mutation tests against the REAL tree: re-introduce the two classes of bug
 # the shapeflow pass exists to catch, and prove it catches them.
@@ -293,6 +393,62 @@ def test_planted_encoder_warmup_removal_is_caught_as_shp002(tmp_path):
     hits = [f for f in findings if f.rule == "SHP002" and not f.suppressed]
     assert any("JaxBertTextEncoder" in f.message for f in hits), (
         "warmup removal on JaxBertTextEncoder escaped SHP002")
+
+
+def test_planted_pipeline_dropped_tp_reduce_is_caught_as_spd003(tmp_path):
+    """Drop the Megatron row-parallel psum from the pp training body: the
+    tp-partitioned layer inputs then leave the shard_map with no reduction
+    over tp under a replicated out_specs, and SPD003 must fire with the
+    in_specs -> no-reduction -> out_specs witness."""
+    dst = _mutated_tree(
+        tmp_path, "training/pipeline.py",
+        'reduce = (lambda x: lax.psum(x, "tp")) if tp > 1 else None',
+        "reduce = None")
+    findings, _ = run_paths([dst])
+    hits = [f for f in findings if f.rule == "SPD003" and not f.suppressed]
+    assert hits, "dropped tp reduce in pp_loss escaped the SPMD pass"
+    (hit,) = hits
+    assert "'tp'" in hit.message
+    assert hit.taint_chain and len(hit.taint_chain) >= 3
+    assert "in_specs" in hit.taint_chain[0]
+    assert "pp_loss" in hit.taint_chain[1]
+    assert "out_specs" in hit.taint_chain[-1]
+
+
+def test_planted_ring_perm_without_modulo_is_caught_as_spd004(tmp_path):
+    """Strip the % axis_size wrap from the ring-attention rotation: the
+    last rank's destination falls off the ring, and SPD004 must anchor the
+    finding at each ppermute with the perm-build step in the witness."""
+    dst = _mutated_tree(
+        tmp_path, "parallel/ring_attention.py",
+        "perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]",
+        "perm = [(j, j + 1) for j in range(axis_size)]")
+    findings, _ = run_paths([dst])
+    hits = [f for f in findings if f.rule == "SPD004" and not f.suppressed]
+    assert hits, "unwrapped ring perm escaped the SPMD pass"
+    assert all("% axis_size" in f.message for f in hits)
+    for f in hits:
+        assert f.taint_chain and "perm built here" in f.taint_chain[0]
+        assert "ring_attention.py:67" in f.taint_chain[0]
+
+
+def test_planted_donated_page_reread_is_caught_as_spd002(tmp_path):
+    """Stop rebinding the scatter_pages result on the migrate path: the
+    donated device page pools are then re-read on the next loop pass, and
+    SPD002 must carry the donate-site -> stale-read witness."""
+    dst = _mutated_tree(
+        tmp_path, "serving/engine.py",
+        "self._dk_pages, self._dv_pages, _, _ = scatter_pages(",
+        "_, _, _, _ = scatter_pages(")
+    findings, _ = run_paths([dst])
+    hits = [f for f in findings if f.rule == "SPD002" and not f.suppressed]
+    assert hits, "donated page-pool re-read escaped the SPMD pass"
+    assert any("self._dk_pages" in f.message for f in hits)
+    for f in hits:
+        assert f.taint_chain
+        assert "scatter_pages" in f.taint_chain[0]
+        assert "donate position" in f.taint_chain[0]
+        assert "read again" in f.taint_chain[-1]
 
 
 def test_wpa004_positive_catches_both_leak_and_double_free():
@@ -487,25 +643,40 @@ def test_parse_error_becomes_a_finding_not_a_crash():
 def test_json_reporter_schema():
     findings, stats = run_paths([FIXTURES / "asy001_pos.py"])
     payload = json.loads(render_json(findings, stats))
-    assert payload["version"] == 3
+    assert payload["version"] == 4
     assert set(payload["stats"]) == {"files", "findings", "unsuppressed",
-                                     "suppressed", "baselined"}
+                                     "suppressed", "baselined",
+                                     "pass_seconds"}
     assert payload["stats"]["files"] == 1
     assert payload["stats"]["unsuppressed"] == len(payload["findings"]) > 0
     for entry in payload["findings"]:
         assert set(entry) == {"path", "line", "col", "rule", "message",
                               "suppressed", "justification", "qualname",
-                              "baselined", "taint_chain"}
+                              "baselined", "witness"}
         assert entry["rule"] in RULE_IDS
         assert entry["qualname"]  # every finding is attributed to a scope
     assert set(payload["rules"]) == set(ALL_RULE_IDS)
 
 
-def test_json_reporter_carries_taint_chain_for_shp001():
+def test_json_stats_report_per_pass_wall_time():
+    """v4 surfaces where the lint budget goes: one graph build shared by
+    the wpa/shapeflow/spmdflow passes, each timed separately."""
+    findings, stats = run_paths([SPD_FIXTURES / "spd001_pos"])
+    seconds = stats["pass_seconds"]
+    assert set(seconds) == {"graph_build", "per_file", "wpa",
+                            "shapeflow", "spmdflow"}
+    assert all(isinstance(v, float) and v >= 0.0 for v in seconds.values())
+
+
+def test_json_reporter_carries_witness_for_shp001_and_spd002():
     findings, stats = run_paths([SHP_FIXTURES / "shp001_pos"])
     payload = json.loads(render_json(findings, stats))
     (entry,) = [e for e in payload["findings"] if e["rule"] == "SHP001"]
-    assert isinstance(entry["taint_chain"], list) and len(entry["taint_chain"]) >= 3
+    assert isinstance(entry["witness"], list) and len(entry["witness"]) >= 3
+    findings, stats = run_paths([SPD_FIXTURES / "spd002_pos"])
+    payload = json.loads(render_json(findings, stats))
+    entries = [e for e in payload["findings"] if e["rule"] == "SPD002"]
+    assert entries and all(isinstance(e["witness"], list) for e in entries)
 
 
 def test_sarif_reporter_schema():
@@ -535,11 +706,38 @@ def test_sarif_reporter_schema():
         assert loc["region"]["startColumn"] >= 1
     by_rule = {r["ruleId"]: r for r in run["results"]}
     # SHP001's witness rides in the message text
-    assert "taint chain:" in by_rule["SHP001"]["message"]["text"]
+    assert "witness chain:" in by_rule["SHP001"]["message"]["text"]
     assert "suppressions" not in by_rule["SHP001"]
     sup = by_rule["SHP003"]["suppressions"][0]
     assert sup["kind"] == "inSource" and sup["justification"]
     assert run["properties"]["stats"]["suppressed"] == 1
+
+
+def test_ci_artifact_schema_gate(tmp_path):
+    """The exact gate scripts/ci.sh runs over artifacts/tpulint.{json,sarif}:
+    generate both artifacts from a fixture package, pass them through
+    scripts/check_tpulint_schema.py, and prove the checker rejects drift."""
+    findings, stats = run_paths([SPD_FIXTURES / "spd002_pos"])
+    json_path = tmp_path / "tpulint.json"
+    sarif_path = tmp_path / "tpulint.sarif"
+    json_path.write_text(render_json(findings, stats))
+    sarif_path.write_text(render_sarif(findings, stats))
+    proc = subprocess.run(
+        [sys.executable, "scripts/check_tpulint_schema.py",
+         str(json_path), str(sarif_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+    # drift in the pinned version must fail the gate
+    payload = json.loads(json_path.read_text())
+    payload["version"] = 3
+    json_path.write_text(json.dumps(payload))
+    proc = subprocess.run(
+        [sys.executable, "scripts/check_tpulint_schema.py",
+         str(json_path), str(sarif_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "version" in proc.stderr
 
 
 def test_text_reporter_lists_location_and_rule():

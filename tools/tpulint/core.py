@@ -37,9 +37,10 @@ from typing import Iterable, Iterator
 
 from tools.tpulint.rules import RULES, FileContext
 from tools.tpulint.program import analyze_program
-# importing shapeflow registers the SHP rule descriptors in RULES, so
-# suppression directives and --list-rules know them before any run
+# importing shapeflow/spmdflow registers the SHP/SPD rule descriptors in
+# RULES, so suppression directives and --list-rules know them before any run
 import tools.tpulint.shapeflow  # noqa: F401
+import tools.tpulint.spmdflow  # noqa: F401
 
 # meta-rule ids (not suppressible findings about findings)
 RULE_NO_JUSTIFICATION = "LNT000"
@@ -290,6 +291,11 @@ def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = (),
     graphs would fabricate WPA/SHP findings — but per-file rule work and
     reported findings are restricted to the closure.
     """
+    from time import perf_counter
+    pass_seconds: dict[str, float] = {
+        "graph_build": 0.0, "per_file": 0.0, "wpa": 0.0,
+        "shapeflow": 0.0, "spmdflow": 0.0,
+    }
     entries = [(str(p), p.read_text(encoding="utf-8", errors="replace"))
                for p in iter_py_files(paths, excludes)]
 
@@ -301,15 +307,17 @@ def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = (),
     def in_scope(path: str) -> bool:
         return only is None or path.replace("\\", "/") in only
 
+    t_files = perf_counter()
     analyses: list[_FileAnalysis] = []
     for path, source in entries:
         analyses.append(_collect_file(source, path, run_rules=in_scope(path)))
+    pass_seconds["per_file"] = perf_counter() - t_files
 
     if program:
         prog_files = [(fa.path, fa.tree, fa.source) for fa in analyses
                       if fa.tree is not None and not fa.is_test_file]
         prog_by_path: dict[str, list] = {}
-        for pf in analyze_program(prog_files):
+        for pf in analyze_program(prog_files, timings=pass_seconds):
             prog_by_path.setdefault(pf.path, []).append(pf)
         for fa in analyses:
             if not in_scope(fa.path):
@@ -345,6 +353,7 @@ def run_paths(paths: Iterable[str | Path], excludes: Iterable[str] = (),
         "unsuppressed": unsuppressed,
         "suppressed": len(findings) - unsuppressed,
         "baselined": 0,
+        "pass_seconds": {k: round(v, 4) for k, v in pass_seconds.items()},
     }
     if only is not None:
         stats["diff_selected"] = len(only)

@@ -1374,15 +1374,34 @@ _register_program_rule(
 )
 
 
-def analyze_program(files: list[tuple[str, ast.Module, str]]) -> list[ProgramFinding]:
-    """Run the whole-program pass. ``files`` = [(display_path, tree, source)]."""
+def analyze_program(files: list[tuple[str, ast.Module, str]],
+                    timings: dict | None = None) -> list[ProgramFinding]:
+    """Run the whole-program pass. ``files`` = [(display_path, tree, source)].
+
+    The call graph is built ONCE here and shared by the WPA, shapeflow and
+    spmdflow passes.  ``timings``, when given, receives per-pass wall time
+    in seconds under ``graph_build``/``wpa``/``shapeflow``/``spmdflow``.
+    """
+    from time import perf_counter
+    t0 = perf_counter()
     program = Program.build(files)
+    t1 = perf_counter()
     findings: list[ProgramFinding] = []
     for rule_id in sorted(_WPA_CHECKS):
         findings.extend(_WPA_CHECKS[rule_id](program))
-    # the shape-provenance pass shares this Program instance; the import is
-    # deferred because shapeflow imports this module's data model
+    t2 = perf_counter()
+    # the shape-provenance and SPMD passes share this Program instance; the
+    # imports are deferred because both modules import this data model
     from tools.tpulint.shapeflow import run_shapeflow
     findings.extend(run_shapeflow(program))
+    t3 = perf_counter()
+    from tools.tpulint.spmdflow import run_spmdflow
+    findings.extend(run_spmdflow(program))
+    t4 = perf_counter()
+    if timings is not None:
+        timings["graph_build"] = t1 - t0
+        timings["wpa"] = t2 - t1
+        timings["shapeflow"] = t3 - t2
+        timings["spmdflow"] = t4 - t3
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -3,19 +3,22 @@
 The JSON shape is stable API for CI consumers:
 
     {
-      "version": 3,
+      "version": 4,
       "findings": [{"path", "line", "col", "rule", "message",
                     "suppressed", "justification", "qualname",
-                    "baselined", "taint_chain"}, ...],
+                    "baselined", "witness"}, ...],
       "stats": {"files", "findings", "unsuppressed", "suppressed",
-                "baselined"},
+                "baselined", "pass_seconds"},
       "rules": {"TPU001": "<summary>", ...}
     }
 
 Version history: v1 had no qualname/baselined fields and no baselined
-stat; v2 added them; v3 adds ``taint_chain`` (the shapeflow SHP001
-source→sink witness — a list of step strings, or null for every other
-rule).  Consumers pinning an older version must update when reading v3.
+stat; v2 added them; v3 added ``taint_chain`` (the shapeflow SHP001
+source→sink witness); v4 renames it ``witness`` — the SPD rules carry
+call-chain witnesses through the same field, so the old taint-specific
+name no longer fits — and adds the per-pass ``stats.pass_seconds`` block
+(``graph_build``/``per_file``/``wpa``/``shapeflow``/``spmdflow``).
+Consumers pinning an older version must update when reading v4.
 
 ``render_sarif`` emits SARIF 2.1.0 so findings render as GitHub
 code-scanning annotations; suppressed/baselined findings carry a SARIF
@@ -30,7 +33,7 @@ from typing import Iterable
 from tools.tpulint.core import Finding
 from tools.tpulint.rules import RULES
 
-JSON_SCHEMA_VERSION = 3
+JSON_SCHEMA_VERSION = 4
 
 SARIF_VERSION = "2.1.0"
 SARIF_SCHEMA = "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
@@ -73,7 +76,7 @@ def render_json(findings: Iterable[Finding], stats: dict) -> str:
                 "justification": f.justification,
                 "qualname": f.qualname,
                 "baselined": f.baselined,
-                "taint_chain": list(f.taint_chain) if f.taint_chain else None,
+                "witness": list(f.taint_chain) if f.taint_chain else None,
             }
             for f in findings
         ],
@@ -99,7 +102,7 @@ def render_sarif(findings: Iterable[Finding], stats: dict) -> str:
     for f in findings:
         message = f.message
         if f.taint_chain:
-            message += "\ntaint chain:\n" + "\n".join(
+            message += "\nwitness chain:\n" + "\n".join(
                 f"  {i + 1}. {step}" for i, step in enumerate(f.taint_chain))
         result: dict = {
             "ruleId": f.rule,

@@ -1,0 +1,1589 @@
+"""SPMD partition-correctness & donation-safety pass (SPD001–005).
+
+Every new serving feature adds ``shard_map``/collective/donation code, and
+the bug class that actually hurts — a collective over a misspelled axis
+name, a psum whose result is re-scattered by ``out_specs``, a donated KV
+pool read after the jit consumed it, a ring permutation that silently
+drops a rank — compiles fine and runs fine on the 1-device CPU test mesh.
+It only corrupts data (or crashes) on a real multi-device mesh.  This pass
+proves the SPMD partitioning contract statically, on top of the already
+built ``program.py`` cross-module call graph (one graph build serves the
+WPA, shapeflow and spmdflow passes).
+
+Rules
+-----
+
+* **SPD001** — a collective (``psum``/``pmean``/``all_gather``/
+  ``ppermute``/``axis_index``/...) names an axis that no reaching
+  ``shard_map`` site or mesh construction binds.  Axis arguments are
+  resolved through ``axis_name=`` parameters, ``functools.partial``
+  bindings and call-site constants, cross-module; the mesh axis universe
+  is read from ``Mesh(devices, axis_names)`` constructions (module
+  constants like ``AXIS_NAMES`` included).
+* **SPD002** — use-after-donation: a buffer passed in a
+  ``donate_argnums``/``donate_argnames`` position of a jitted call
+  (decorator, ``partial(jax.jit, ...)``, or ``g = jax.jit(f, ...)``
+  assignment) is read again afterwards on some path.  The rebinding idiom
+  ``x, y = f(x, y)`` clears the donation; branch arms are tracked
+  separately and loops run twice so a donation late in the body reaches a
+  read early in the next iteration.  Helpers that consume a parameter
+  (pass it to a donating jit without rebinding) propagate the donation to
+  their callers, so the finding carries the full call-chain witness.
+* **SPD003** — reduction/out_specs mismatch: a value ``psum``-reduced
+  over axis A is returned from a shard_map body whose ``out_specs`` still
+  partitions over A (the replicated result gets re-scattered), or a
+  shard-variant value (partitioned input, ``axis_index``/``ppermute``
+  product) is returned under a spec that does not partition its axis and
+  no reduction over that axis exists in the body — each shard silently
+  returns a different value that downstream code treats as replicated.
+  Tracked branch-sensitively per return statement, plus a body-level
+  conservation check that catches a dropped reduce even through nested
+  ``scan``/helper indirection.
+* **SPD004** — ring-permutation hazard: a ``ppermute`` permutation built
+  with index arithmetic that is not a total modular cyclic shift — a
+  missing ``% axis_size`` pushes the last rank out of range, and a
+  modulus or ``range()`` bound that differs from the ring size leaves
+  ranks uncovered.
+* **SPD005** — a shard_map body reads a closed-over module/global device
+  array (a ``jnp.zeros``/``arange``/``device_put``-style binding outside
+  the body) — it is captured as a trace constant and silently replicated
+  per shard instead of arriving partitioned through ``in_specs``.
+
+Everything is stdlib-``ast`` and runs over the shared ``Program`` in the
+same ``make lint`` invocation; suppressions, baseline fingerprints and
+the reporters treat SPD findings exactly like every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from dataclasses import dataclass, field
+
+from tools.tpulint.program import (
+    FuncInfo,
+    Program,
+    ProgramFinding,
+    _register_program_rule,
+    _walk_own,
+)
+from tools.tpulint.rules import (
+    RULES,
+    FileContext,
+    JitSpec,
+    dotted,
+    jit_spec_of,
+    jitted_callables,
+    jitted_functions,
+)
+
+_MAX_CHAIN = 8
+
+# collective name -> positional index of the axis-name argument
+_COLLECTIVE_AXIS_ARG = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "axis_index": 0,
+}
+# collectives that make a value consistent (reduce/gather) along the axis
+_REDUCING = {"psum", "pmean", "pmax", "pmin", "psum_scatter"}
+_GATHERING = {"all_gather", "all_to_all"}
+
+_SPEC_NAMES = {"P", "PartitionSpec"}
+
+# jnp/jax array-creation calls whose closed-over result replicates per shard
+_ARRAY_CREATORS = {
+    "zeros", "ones", "full", "empty", "arange", "eye", "linspace", "tri",
+    "asarray", "array", "device_put", "zeros_like", "ones_like",
+    "full_like", "iota", "broadcasted_iota",
+}
+_DEVICE_ROOTS = {"jnp", "jax", "lax", "jax.numpy", "jax.lax"}
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+def _walk_scope(node: ast.AST):
+    """Walk a function body without descending into nested *defs* but
+    descending into lambdas (lambdas are not separately indexed)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        yield sub
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _params_of(fi: FuncInfo) -> list[str]:
+    a = fi.node.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _param_defaults(fi: FuncInfo) -> dict[str, ast.expr]:
+    """param name -> default expression (positional + keyword-only)."""
+    a = fi.node.args
+    out: dict[str, ast.expr] = {}
+    positional = [*a.posonlyargs, *a.args]
+    for p, d in zip(reversed(positional), reversed(a.defaults)):
+        out[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            out[p.arg] = d
+    return out
+
+
+@dataclass
+class SmapSite:
+    """One shard_map(...) wrapping: body callable + specs + mesh axes."""
+    fn: FuncInfo                         # function containing the call
+    call: ast.Call
+    bodies: list[FuncInfo]
+    partial_kw: dict[str, ast.expr]      # partial(body, axis_name=..., ...)
+    mesh_axes: frozenset[str] | None     # None = could not resolve
+    in_specs: ast.expr | None
+    out_specs: ast.expr | None
+
+    def step(self) -> str:
+        names = ", ".join(sorted(b.name for b in self.bodies)) or "<unresolved>"
+        return (f"shard_map wraps '{names}' "
+                f"[{self.fn.module.path}:{self.call.lineno}]")
+
+
+@dataclass
+class SpecEntry:
+    """One positional PartitionSpec: the axis names it mentions, and
+    whether every component resolved to a literal."""
+    axes: frozenset[str] = frozenset()
+    known: bool = True
+
+
+# --------------------------------------------------------------------------
+# the pass
+
+class SpmdFlow:
+    """SPMD partitioning/donation checks over one built ``Program``."""
+
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        self.findings: list[ProgramFinding] = []
+        self._seen_keys: set[tuple] = set()
+        self.jit_spec_by_fn: dict[int, JitSpec] = {}
+        self._jit_by_qual: dict[str, JitSpec] = {}
+        self.ref_edges: dict[int, list[FuncInfo]] = {}
+        # callee fn-id -> [(call, caller fn, is_partial)] for axis-parameter
+        # resolution (Edge records only the line, not the Call node)
+        self.call_sites: dict[int, list[tuple[ast.Call, FuncInfo, bool]]] = {}
+        # SPD002 interprocedural summaries: fn-id -> {param: witness chain}
+        self.donation_summaries: dict[int, dict[str, tuple[str, ...]]] = {}
+        self._index_jits()
+        self._collect_refs_and_sites()
+        self._tpu006_lines = self._index_tpu006_anchors()
+        self.mesh_universe = self._collect_mesh_universe()
+        self.sites = self._collect_smap_sites()
+        # fn-id -> (bound axes, any-unknown-site flag, witness chain to it)
+        self.bound_axes = self._propagate_bound_axes()
+
+    # ----------------------------------------------------------- jit index
+
+    def _index_jits(self) -> None:
+        node_specs: dict[int, JitSpec] = {}
+        for mod in self.program.modules.values():
+            for node, spec in jitted_functions(mod.tree).items():
+                node_specs[id(node)] = spec
+            for name, spec in jitted_callables(mod.tree).items():
+                self._jit_by_qual[f"{mod.modname}.{name}"] = spec
+        for fi in self.program.functions:
+            spec = node_specs.get(id(fi.node))
+            if spec is not None:
+                self.jit_spec_by_fn[id(fi)] = spec
+
+    def is_jitted(self, fi: FuncInfo) -> bool:
+        return id(fi) in self.jit_spec_by_fn
+
+    def _index_tpu006_anchors(self) -> set[tuple[str, int]]:
+        """(path, line) anchors the per-file TPU006 rule already reports.
+        SPD002 is its interprocedural superset — like WPA001 over ASY001,
+        the program rule leaves the same-file straight-line shape to the
+        per-file rule instead of double-reporting it."""
+        anchors: set[tuple[str, int]] = set()
+        rule = RULES.get("TPU006")
+        if rule is None:
+            return anchors
+        for mod in self.program.modules.values():
+            ctx = FileContext(path=mod.path,
+                              source="\n".join(mod.source_lines),
+                              tree=mod.tree)
+            for line, _col, _msg in rule.check(ctx):
+                anchors.add((mod.path, line))
+        return anchors
+
+    def jit_spec_for_call(
+        self, call: ast.Call, fn: FuncInfo
+    ) -> tuple[JitSpec | None, FuncInfo | None, str]:
+        """(spec, callee FuncInfo if known, display name) when ``call``
+        dispatches a jitted callable (mirrors shapeflow's resolution)."""
+        if jit_spec_of(call) is not None:
+            return None, None, ""  # constructs a jit, no dispatch
+        for fi in self._resolve(call, fn):
+            spec = self.jit_spec_by_fn.get(id(fi))
+            if spec is not None:
+                return spec, fi, fi.qualname
+        d = dotted(call.func)
+        if d:
+            head, _, rest = d.partition(".")
+            if head in fn.module.alias:
+                qual = fn.module.alias[head] + ("." + rest if rest else "")
+                spec = self._jit_by_qual.get(qual)
+                if spec is not None:
+                    return spec, None, qual
+            spec = self._jit_by_qual.get(f"{fn.module.modname}.{d}")
+            if spec is not None:
+                return spec, None, d
+            last = d.rsplit(".", 1)[-1]
+            if "jit" in last.lower() and last not in ("jit", "pjit"):
+                return JitSpec(), None, d  # opaque handle, donation unknown
+        return None, None, ""
+
+    def _resolve(self, call: ast.Call, fn: FuncInfo) -> list[FuncInfo]:
+        d = dotted(call.func)
+        if isinstance(call.func, ast.Name):
+            return self.program.resolve_callable_ref(call.func, fn)
+        if d is not None:
+            return self.program._resolve_dotted_call(d, fn)
+        return []
+
+    def _collect_refs_and_sites(self) -> None:
+        for fn in list(self.program.functions):
+            refs: list[FuncInfo] = []
+            for node in _walk_own(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in self._resolve(node, fn):
+                    self.call_sites.setdefault(id(callee), []).append(
+                        (node, fn, False))
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(a, ast.Call):
+                        fd = (dotted(a.func) or "").rsplit(".", 1)[-1]
+                        if fd != "partial" or not a.args:
+                            continue
+                        for callee in self.program.resolve_callable_ref(
+                                a.args[0], fn):
+                            refs.append(callee)
+                            self.call_sites.setdefault(id(callee), []).append(
+                                (a, fn, True))
+                        continue
+                    if not isinstance(a, (ast.Name, ast.Attribute)):
+                        continue
+                    refs.extend(self.program.resolve_callable_ref(a, fn))
+            if refs:
+                self.ref_edges[id(fn)] = refs
+
+    # ------------------------------------------------------- mesh universe
+
+    def _collect_mesh_universe(self) -> frozenset[str]:
+        """Axis names bound by any ``Mesh(devices, axis_names)``
+        construction in the program (module constants resolved)."""
+        axes: set[str] = set()
+        for mod in self.program.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                last = (dotted(node.func) or "").rsplit(".", 1)[-1]
+                if last not in ("Mesh", "make_mesh"):
+                    continue
+                if last == "make_mesh" and (dotted(node.func) or "") not in (
+                        "jax.make_mesh", "jax.sharding.make_mesh"):
+                    continue
+                expr: ast.expr | None = None
+                for kw in node.keywords:
+                    if kw.arg in ("axis_names", "axis_name"):
+                        expr = kw.value
+                if expr is None and len(node.args) > 1:
+                    expr = node.args[1]
+                got = self._const_axis_names(expr, mod)
+                if got:
+                    axes |= got
+        return frozenset(axes)
+
+    def _const_axis_names(self, expr: ast.expr | None, mod) -> set[str]:
+        if expr is None:
+            return set()
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return {expr.value}
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: set[str] = set()
+            for elt in expr.elts:
+                out |= self._const_axis_names(elt, mod)
+            return out
+        if isinstance(expr, ast.Name):
+            # same-module constant, or an alias to another module's constant
+            binding = self._module_constant(mod, expr.id)
+            if binding is not None:
+                return self._const_axis_names(binding[1], binding[0])
+        return set()
+
+    def _module_constant(self, mod, name: str):
+        """(owning module, value expr) of a module-level assignment."""
+        if name in mod.alias:
+            target = mod.alias[name]
+            owner_name, _, const = target.rpartition(".")
+            owner = self.program.modules.get(owner_name)
+            if owner is not None and const:
+                return self._module_constant(owner, const)
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        return (mod, stmt.value)
+            elif (isinstance(stmt, ast.AnnAssign)
+                  and isinstance(stmt.target, ast.Name)
+                  and stmt.target.id == name and stmt.value is not None):
+                return (mod, stmt.value)
+        return None
+
+    # ------------------------------------------------------ shard_map sites
+
+    def _collect_smap_sites(self) -> list[SmapSite]:
+        sites: list[SmapSite] = []
+        for fn in list(self.program.functions):
+            if fn.name == "shard_map":
+                continue  # the compat shim's own forwarding is not a site
+            for node in _walk_scope(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                last = (dotted(node.func) or "").rsplit(".", 1)[-1]
+                if last != "shard_map":
+                    continue
+                kw = {k.arg: k.value for k in node.keywords if k.arg}
+                body_expr = node.args[0] if node.args else kw.get("f")
+                if body_expr is None:
+                    continue
+                partial_kw: dict[str, ast.expr] = {}
+                if (isinstance(body_expr, ast.Call)
+                        and (dotted(body_expr.func) or "").rsplit(".", 1)[-1]
+                        == "partial"):
+                    partial_kw = {k.arg: k.value for k in body_expr.keywords
+                                  if k.arg}
+                bodies = self.program.resolve_callable_ref(body_expr, fn)
+                mesh_expr = kw.get("mesh")
+                if mesh_expr is None and len(node.args) > 1:
+                    mesh_expr = node.args[1]
+                in_specs = kw.get("in_specs")
+                if in_specs is None and len(node.args) > 2:
+                    in_specs = node.args[2]
+                out_specs = kw.get("out_specs")
+                if out_specs is None and len(node.args) > 3:
+                    out_specs = node.args[3]
+                sites.append(SmapSite(
+                    fn, node, bodies, partial_kw,
+                    self._mesh_axes_of(mesh_expr, fn), in_specs, out_specs))
+        return sites
+
+    def _mesh_axes_of(self, expr: ast.expr | None,
+                      fn: FuncInfo) -> frozenset[str] | None:
+        """Axis names of a mesh expression at a shard_map site, or None."""
+        if expr is None:
+            return None
+        for _ in range(4):
+            if isinstance(expr, ast.Call):
+                last = (dotted(expr.func) or "").rsplit(".", 1)[-1]
+                if last in ("Mesh", "make_mesh"):
+                    names_expr: ast.expr | None = None
+                    for kw in expr.keywords:
+                        if kw.arg in ("axis_names", "axis_name"):
+                            names_expr = kw.value
+                    if names_expr is None and len(expr.args) > 1:
+                        names_expr = expr.args[1]
+                    got = self._const_axis_names(names_expr, fn.module)
+                    return frozenset(got) if got else None
+                return None
+            if isinstance(expr, ast.Name):
+                binding = None
+                for node in _walk_own(fn.node):
+                    if isinstance(node, ast.Assign):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                                binding = node.value
+                if binding is None:
+                    return None
+                expr = binding
+                continue
+            return None
+        return None
+
+    # -------------------------------------------------- SPD001 reachability
+
+    def _propagate_bound_axes(self):
+        """fn-id -> (axes union, unknown-axes-site-reaches flag, chain)."""
+        bound: dict[int, tuple[set[str], bool, tuple[str, ...]]] = {}
+        stack: list[FuncInfo] = []
+        for site in self.sites:
+            axes = set(site.mesh_axes) if site.mesh_axes is not None else set(
+                self.mesh_universe)
+            unknown = site.mesh_axes is None and not self.mesh_universe
+            for body in site.bodies:
+                prev = bound.get(id(body))
+                chain = (site.step(),)
+                if prev is None:
+                    bound[id(body)] = (set(axes), unknown, chain)
+                    stack.append(body)
+                else:
+                    before = (set(prev[0]), prev[1])
+                    prev[0].update(axes)
+                    merged_unknown = prev[1] or unknown
+                    bound[id(body)] = (prev[0], merged_unknown, prev[2])
+                    if (set(prev[0]), merged_unknown) != before:
+                        stack.append(body)
+        while stack:
+            fn = stack.pop()
+            axes, unknown, chain = bound[id(fn)]
+            succs = [e.callee for e in
+                     self.program._edges_by_caller.get(id(fn), ())]
+            succs.extend(self.ref_edges.get(id(fn), ()))
+            for callee in succs:
+                step = (f"'{fn.name}' calls '{callee.name}' "
+                        f"[{fn.module.path}:{fn.node.lineno}]")
+                new_chain = chain + (step,) if len(chain) < _MAX_CHAIN else chain
+                prev = bound.get(id(callee))
+                if prev is None:
+                    bound[id(callee)] = (set(axes), unknown, new_chain)
+                    stack.append(callee)
+                else:
+                    before = (set(prev[0]), prev[1])
+                    prev[0].update(axes)
+                    merged = prev[1] or unknown
+                    bound[id(callee)] = (prev[0], merged, prev[2])
+                    if (set(prev[0]), merged) != before:
+                        stack.append(callee)
+        return bound
+
+    # --------------------------------------------------- axis-value lookup
+
+    def collective_of(self, call: ast.Call, fn: FuncInfo) -> str | None:
+        """Collective name when ``call`` is a lax collective, else None."""
+        fd = dotted(call.func)
+        if fd is None:
+            return None
+        parts = fd.split(".")
+        last = parts[-1]
+        if last not in _COLLECTIVE_AXIS_ARG:
+            return None
+        if len(parts) == 1:
+            if last in fn.module.functions or last in fn.local_defs:
+                return None  # shadowed by an in-repo def
+            target = fn.module.alias.get(last, "")
+            if target and not target.startswith(("jax", "lax")):
+                return None
+            return last
+        head = fn.module.alias.get(parts[0], parts[0])
+        if head.split(".")[0] in ("jax", "lax"):
+            return last
+        return None
+
+    def axis_expr_of(self, call: ast.Call, name: str) -> ast.expr | None:
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                return kw.value
+        idx = _COLLECTIVE_AXIS_ARG[name]
+        if len(call.args) > idx:
+            return call.args[idx]
+        return None
+
+    def axis_values(self, expr: ast.expr | None, fn: FuncInfo,
+                    depth: int = 0,
+                    _seen: frozenset = frozenset()) -> frozenset[str] | None:
+        """Literal axis names an expression can take, or None if any part
+        is unresolvable (strict: SPD001/SPD004 never fire on unknowns)."""
+        if expr is None or depth > 4:
+            return None
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, str):
+                return frozenset((expr.value,))
+            if expr.value is None:
+                return frozenset()
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: set[str] = set()
+            for elt in expr.elts:
+                got = self.axis_values(elt, fn, depth, _seen)
+                if got is None:
+                    return None
+                out |= got
+            return frozenset(out)
+        if isinstance(expr, ast.IfExp):
+            a = self.axis_values(expr.body, fn, depth, _seen)
+            b = self.axis_values(expr.orelse, fn, depth, _seen)
+            if a is None or b is None:
+                return None
+            return a | b
+        if isinstance(expr, ast.Name):
+            key = (id(fn), expr.id)
+            if key in _seen:
+                return None
+            _seen = _seen | {key}
+            local = self._local_binding(fn, expr.id)
+            if local is not None:
+                return self.axis_values(local, fn, depth, _seen)
+            if expr.id in _params_of(fn):
+                return self._param_axis_values(fn, expr.id, depth + 1, _seen)
+            binding = self._module_constant(fn.module, expr.id)
+            if binding is not None and isinstance(
+                    binding[1], (ast.Constant, ast.Tuple, ast.List)):
+                return self.axis_values(binding[1], fn, depth, _seen)
+            return None
+        return None
+
+    def _local_binding(self, fn: FuncInfo, name: str) -> ast.expr | None:
+        found: ast.expr | None = None
+        for node in _walk_own(fn.node):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        found = node.value
+        return found
+
+    def _param_axis_values(self, fn: FuncInfo, param: str, depth: int,
+                           _seen: frozenset) -> frozenset[str] | None:
+        """Union of the values callers pass for ``param`` (defaults count
+        for call sites that omit it); None when any site is opaque."""
+        params = _params_of(fn)
+        try:
+            p_idx = params.index(param)
+        except ValueError:
+            return None
+        default = _param_defaults(fn).get(param)
+        out: set[str] = set()
+        sites = self.call_sites.get(id(fn), [])
+        if not sites:
+            if default is not None:
+                return self.axis_values(default, fn, depth, _seen)
+            return None
+        for call, caller, is_partial in sites:
+            expr: ast.expr | None = None
+            for kw in call.keywords:
+                if kw.arg == param:
+                    expr = kw.value
+            if expr is None and not is_partial:
+                offset = 1 if (params[:1] in (["self"], ["cls"])
+                               and isinstance(call.func, ast.Attribute)) else 0
+                arg_i = p_idx - offset
+                if 0 <= arg_i < len(call.args):
+                    expr = call.args[arg_i]
+            if expr is None and is_partial:
+                arg_i = p_idx + 1  # args[0] is the wrapped callable
+                if arg_i < len(call.args):
+                    expr = call.args[arg_i]
+            if expr is None:
+                expr = default
+            if expr is None:
+                return None
+            got = self.axis_values(expr, caller, depth, _seen)
+            if got is None:
+                return None
+            out |= got
+        return frozenset(out)
+
+    # ------------------------------------------------------------ findings
+
+    def emit(self, fn: FuncInfo, node: ast.AST, rule: str, message: str,
+             chain: tuple[str, ...] = ()) -> None:
+        key = (fn.module.path, node.lineno, node.col_offset, rule)
+        if key in self._seen_keys:
+            return
+        self._seen_keys.add(key)
+        self.findings.append(ProgramFinding(
+            fn.module.path, node.lineno, node.col_offset, rule, message,
+            chain=chain or None))
+
+    # ---------------------------------------------------------------- run
+
+    def run(self) -> list[ProgramFinding]:
+        self._check_spd001()
+        self._check_spd002()
+        self._check_spd003()
+        self._check_spd004()
+        self._check_spd005()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return self.findings
+
+    # -------------------------------------------------------------- SPD001
+
+    def _check_spd001(self) -> None:
+        for fn in sorted(self.program.functions, key=lambda f: f.qualname):
+            reach = self.bound_axes.get(id(fn))
+            if reach is not None:
+                axes, unknown, chain = reach
+                if unknown:
+                    continue  # an opaque mesh may bind anything
+            else:
+                if not self.mesh_universe:
+                    continue
+                axes, chain = set(self.mesh_universe), ()
+            for node in _walk_scope(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self.collective_of(node, fn)
+                if name is None:
+                    continue
+                values = self.axis_values(self.axis_expr_of(node, name), fn)
+                if values is None:
+                    continue
+                for axis in sorted(values - axes):
+                    step = (f"lax.{name}(..., {axis!r}) "
+                            f"[{fn.module.path}:{node.lineno}]")
+                    self.emit(
+                        fn, node, "SPD001",
+                        f"collective {name}() uses axis {axis!r}, which no "
+                        f"reaching shard_map or mesh binds (known axes: "
+                        f"{', '.join(sorted(axes)) or 'none'}) — this traces "
+                        f"fine single-device and fails (or silently no-ops) "
+                        f"on a real mesh; fix the axis name or bind it in "
+                        f"the mesh/shard_map wrapping this code",
+                        chain=chain + (step,))
+
+    # -------------------------------------------------------------- SPD002
+
+    def _check_spd002(self) -> None:
+        order = sorted(self.program.functions, key=lambda f: f.qualname)
+        # summary fixpoint: which params does a function consume (donate
+        # without rebinding)?  Two extra rounds cover transitive helpers.
+        for _ in range(3):
+            changed = False
+            for fn in order:
+                if self.is_jitted(fn) or isinstance(fn.node, ast.Lambda):
+                    continue
+                interp = _DonationInterp(self, fn, emit=False)
+                interp.run()
+                summary = {k: v for k, v in interp.final_env().items()
+                           if "." not in k and k in _params_of(fn)}
+                if summary != self.donation_summaries.get(id(fn), {}):
+                    self.donation_summaries[id(fn)] = summary
+                    changed = True
+            if not changed:
+                break
+        for fn in order:
+            if self.is_jitted(fn) or isinstance(fn.node, ast.Lambda):
+                continue  # inside a jit the donation is a trace-time no-op
+            _DonationInterp(self, fn, emit=True).run()
+
+    # -------------------------------------------------------------- SPD003
+
+    def _spec_entries(self, expr: ast.expr | None, fn: FuncInfo,
+                      depth: int = 0) -> list[SpecEntry] | None:
+        """Positional PartitionSpec entries of an in_specs/out_specs
+        expression; None when nothing resolves at all."""
+        if expr is None or depth > 4:
+            return None
+        if isinstance(expr, ast.Constant) and expr.value is None:
+            return [SpecEntry()]
+        if isinstance(expr, ast.Call):
+            last = (dotted(expr.func) or "").rsplit(".", 1)[-1]
+            if last in _SPEC_NAMES:
+                axes: set[str] = set()
+                known = True
+                parts = list(expr.args) + [kw.value for kw in expr.keywords]
+                for part in parts:
+                    got = self.axis_values(part, fn)
+                    if got is None:
+                        known = False
+                    else:
+                        axes |= got
+                return [SpecEntry(frozenset(axes), known)]
+            # helper call (e.g. pp_layer_specs(tp)): harvest every P(...)
+            # literal in the callee's body — the returns often assemble
+            # specs from locals, so return-only harvesting misses axes
+            callees = self._resolve(expr, fn)
+            if callees:
+                axes = set()
+                found = False
+                for fi in callees:
+                    for sub in ast.walk(fi.node):
+                        if (isinstance(sub, ast.Call)
+                                and (dotted(sub.func) or "").rsplit(
+                                    ".", 1)[-1] in _SPEC_NAMES):
+                            found = True
+                            for p in list(sub.args) + [
+                                    kw.value for kw in sub.keywords]:
+                                got = self.axis_values(p, fi)
+                                if got is not None:
+                                    axes |= got
+                if found:
+                    return [SpecEntry(frozenset(axes), False)]
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: list[SpecEntry] = []
+            for elt in expr.elts:
+                sub = self._spec_entries(elt, fn, depth + 1)
+                if sub is None:
+                    out.append(SpecEntry(frozenset(), False))
+                else:
+                    out.extend(sub)
+            return out
+        if isinstance(expr, ast.Name):
+            binding = self._local_binding(fn, expr.id)
+            if binding is not None:
+                if isinstance(binding, ast.IfExp):
+                    a = self._spec_entries(binding.body, fn, depth + 1)
+                    b = self._spec_entries(binding.orelse, fn, depth + 1)
+                    if a and b and len(a) == 1 and len(b) == 1:
+                        return [SpecEntry(a[0].axes | b[0].axes,
+                                          a[0].known and b[0].known)]
+                    return a or b
+                return self._spec_entries(binding, fn, depth + 1)
+            return None
+        if isinstance(expr, ast.IfExp):
+            a = self._spec_entries(expr.body, fn, depth + 1)
+            b = self._spec_entries(expr.orelse, fn, depth + 1)
+            if a and b and len(a) == 1 and len(b) == 1:
+                return [SpecEntry(a[0].axes | b[0].axes,
+                                  a[0].known and b[0].known)]
+            return a or b
+        return None
+
+    def scope_axes(self, site: SmapSite,
+                   body: FuncInfo) -> tuple[set[str], set[str]]:
+        """(reduced/gathered axes, shard-variance source axes) anywhere in
+        the body's full textual scope (nested defs and lambdas included)."""
+        reduced: set[str] = set()
+        variant: set[str] = set()
+        for node in ast.walk(body.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.collective_of(node, body)
+            if name is None:
+                continue
+            values = self._body_axis_values(
+                self.axis_expr_of(node, name), body, site)
+            if values is None:
+                continue
+            if name in _REDUCING or name in _GATHERING:
+                reduced |= values
+            if name in ("axis_index", "ppermute", "pshuffle"):
+                variant |= values
+        return reduced, variant
+
+    def _body_axis_values(self, expr: ast.expr | None, body: FuncInfo,
+                          site: SmapSite) -> frozenset[str] | None:
+        """Axis values inside a shard_map body: the site's partial(...)
+        keyword bindings resolve body parameters."""
+        if isinstance(expr, ast.Name) and expr.id in site.partial_kw:
+            return self.axis_values(site.partial_kw[expr.id], site.fn)
+        return self.axis_values(expr, body)
+
+    def _check_spd003(self) -> None:
+        for site in self.sites:
+            out_entries = self._spec_entries(site.out_specs, site.fn)
+            if out_entries is None:
+                continue
+            in_entries = self._spec_entries(site.in_specs, site.fn) or []
+            in_axes = set().union(*(e.axes for e in in_entries)) if in_entries else set()
+            out_axes = set().union(*(e.axes for e in out_entries)) if out_entries else set()
+            for body in site.bodies:
+                if isinstance(body.node, ast.Lambda):
+                    continue
+                reduced, variant_src = self.scope_axes(site, body)
+                # body-level conservation: an axis that partitions an input
+                # (or that the body is variant over) must either survive in
+                # out_specs or be reduced/gathered away somewhere in scope
+                for axis in sorted((in_axes | variant_src) - out_axes - reduced):
+                    chain = (
+                        f"in_specs partitions the input over {axis!r} "
+                        f"[{site.fn.module.path}:{site.call.lineno}]"
+                        if axis in in_axes else
+                        f"body '{body.name}' is shard-variant over {axis!r} "
+                        f"(axis_index/ppermute) "
+                        f"[{body.module.path}:{body.node.lineno}]",
+                        f"no psum/all_gather over {axis!r} anywhere in "
+                        f"'{body.name}' [{body.module.path}:{body.node.lineno}]",
+                        f"out_specs does not partition {axis!r} "
+                        f"[{site.fn.module.path}:{site.call.lineno}]",
+                    )
+                    self.emit(
+                        site.fn, site.call, "SPD003",
+                        f"shard_map body '{body.name}' consumes input "
+                        f"partitioned over {axis!r} but returns under "
+                        f"out_specs that neither partitions {axis!r} nor "
+                        f"follows a reduction over it — each shard returns "
+                        f"a different value that downstream code treats as "
+                        f"replicated; psum/all_gather over {axis!r} before "
+                        f"returning, or keep {axis!r} in out_specs",
+                        chain=chain)
+                # per-return dataflow: reduced-vs-partitioned mismatches
+                _ReturnInterp(self, site, body, out_entries, in_entries,
+                              reduced).run()
+
+    # -------------------------------------------------------------- SPD004
+
+    def _check_spd004(self) -> None:
+        for fn in sorted(self.program.functions, key=lambda f: f.qualname):
+            for node in _walk_scope(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self.collective_of(node, fn) != "ppermute":
+                    continue
+                perm = None
+                for kw in node.keywords:
+                    if kw.arg == "perm":
+                        perm = kw.value
+                if perm is None and len(node.args) > 2:
+                    perm = node.args[2]
+                if perm is None:
+                    continue
+                built_at = perm
+                if isinstance(perm, ast.Name):
+                    binding = self._local_binding(fn, perm.id)
+                    if binding is None:
+                        continue
+                    built_at, perm = binding, binding
+                problem = self._perm_problem(perm)
+                if problem is None:
+                    continue
+                axis = self.axis_values(self.axis_expr_of(node, "ppermute"), fn)
+                axis_txt = "/".join(sorted(axis)) if axis else "?"
+                chain = (
+                    f"perm built here [{fn.module.path}:{built_at.lineno}]",
+                    f"lax.ppermute over axis {axis_txt!r} "
+                    f"[{fn.module.path}:{node.lineno}]",
+                )
+                self.emit(
+                    fn, node, "SPD004",
+                    f"ppermute permutation is not a total modular cyclic "
+                    f"shift: {problem} — on a real ring this drops or "
+                    f"collides ranks (the canonical form is "
+                    f"`[(j, (j + 1) % axis_size) for j in "
+                    f"range(axis_size)]`)",
+                    chain=chain)
+
+    def _perm_problem(self, perm: ast.expr) -> str | None:
+        if isinstance(perm, ast.ListComp):
+            if len(perm.generators) != 1:
+                return None
+            gen = perm.generators[0]
+            if not isinstance(gen.target, ast.Name):
+                return None
+            loopvar = gen.target.id
+            it = gen.iter
+            if not (isinstance(it, ast.Call)
+                    and (dotted(it.func) or "") == "range"):
+                return None
+            if len(it.args) != 1:
+                return ("the range() does not start at rank 0, so part of "
+                        "the ring is uncovered")
+            size_txt = ast.unparse(it.args[0]).replace(" ", "")
+            elt = perm.elt
+            if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2):
+                return None
+            src, dst = elt.elts
+            if not (isinstance(src, ast.Name) and src.id == loopvar):
+                return None
+            uses_loopvar = any(isinstance(n, ast.Name) and n.id == loopvar
+                               for n in ast.walk(dst))
+            if isinstance(dst, ast.Name) and dst.id == loopvar:
+                return None  # identity shift, fine
+            if isinstance(dst, ast.BinOp) and isinstance(dst.op, ast.Mod):
+                mod_txt = ast.unparse(dst.right).replace(" ", "")
+                if mod_txt != size_txt:
+                    return (f"the modulus ({mod_txt}) does not match the "
+                            f"ring size the comprehension covers "
+                            f"({size_txt})")
+                if not uses_loopvar:
+                    return "every source maps to the same destination"
+                return None
+            if uses_loopvar and any(isinstance(n, ast.BinOp)
+                                    for n in ast.walk(dst)):
+                return (f"destination `{ast.unparse(dst)}` has no "
+                        f"`% {size_txt}` wrap, so the last rank's target "
+                        f"falls off the ring")
+            if not uses_loopvar:
+                return "every source maps to the same destination"
+            return None
+        if isinstance(perm, (ast.List, ast.Tuple)):
+            srcs: list[int] = []
+            dsts: list[int] = []
+            for elt in perm.elts:
+                if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2
+                        and all(isinstance(e, ast.Constant)
+                                and isinstance(e.value, int)
+                                for e in elt.elts)):
+                    return None
+                srcs.append(elt.elts[0].value)
+                dsts.append(elt.elts[1].value)
+            if not srcs:
+                return None
+            if len(set(dsts)) != len(dsts):
+                return "two sources send to the same destination rank"
+            if set(srcs) != set(dsts):
+                return ("sources and destinations cover different rank "
+                        "sets, so the shift is not a permutation")
+            return None
+        return None
+
+    # -------------------------------------------------------------- SPD005
+
+    def _check_spd005(self) -> None:
+        for site in self.sites:
+            for body in site.bodies:
+                if isinstance(body.node, ast.Lambda):
+                    continue
+                self._spd005_body(site, body)
+
+    def _spd005_body(self, site: SmapSite, body: FuncInfo) -> None:
+        mod = body.module
+        bound: set[str] = set(_params_of(body))
+        for node in ast.walk(body.node):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                bound.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(node.name)
+                a = node.args
+                bound.update(p.arg for p in
+                             (*a.posonlyargs, *a.args, *a.kwonlyargs))
+                if a.vararg:
+                    bound.add(a.vararg.arg)
+                if a.kwarg:
+                    bound.add(a.kwarg.arg)
+            elif isinstance(node, ast.Lambda):
+                a = node.args
+                bound.update(p.arg for p in
+                             (*a.posonlyargs, *a.args, *a.kwonlyargs))
+            elif isinstance(node, ast.ClassDef):
+                bound.add(node.name)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                bound.add(node.name)
+        flagged: set[str] = set()
+        for node in ast.walk(body.node):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            name = node.id
+            if (name in bound or name in flagged or name in _BUILTIN_NAMES
+                    or name in mod.alias or name in mod.functions
+                    or name in mod.classes):
+                continue
+            binding = self._closure_binding(body, name)
+            if binding is None:
+                continue
+            owner_fn, value = binding
+            if not self._is_device_array_creation(value, mod):
+                continue
+            flagged.add(name)
+            chain = (
+                f"{name!r} created with {ast.unparse(value.func)}(...) "
+                f"[{mod.path}:{value.lineno}]",
+                site.step(),
+                f"body '{body.name}' reads {name!r} from its closure "
+                f"[{mod.path}:{node.lineno}]",
+            )
+            self.emit(
+                body, node, "SPD005",
+                f"shard_map body '{body.name}' reads closed-over device "
+                f"array {name!r} — the trace captures it as a constant, so "
+                f"every shard gets a full replicated copy instead of its "
+                f"slice; pass it as an argument with an in_specs entry",
+                chain=chain)
+
+    def _closure_binding(self, body: FuncInfo, name: str):
+        """(owner fn or None, value expr) of an enclosing-scope binding."""
+        enclosers = [g for g in self.program.functions
+                     if body.qualname.startswith(g.qualname + ".")
+                     and not isinstance(g.node, ast.Lambda)]
+        for g in sorted(enclosers, key=lambda g: -len(g.qualname)):
+            value = self._local_binding(g, name)
+            if value is not None:
+                return (g, value)
+        const = self._module_constant(body.module, name)
+        if const is not None:
+            return (None, const[1])
+        return None
+
+    def _is_device_array_creation(self, value: ast.AST, mod) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        fd = dotted(value.func) or ""
+        parts = fd.split(".")
+        if parts[-1] not in _ARRAY_CREATORS:
+            return False
+        if len(parts) == 1:
+            return mod.alias.get(parts[-1], "").startswith("jax")
+        root = mod.alias.get(parts[0], parts[0])
+        return root in _DEVICE_ROOTS or root.startswith("jax")
+
+
+# --------------------------------------------------------------------------
+# SPD002 statement interpreter
+
+class _DonationInterp:
+    """Branch-sensitive use-after-donation tracker for one function.
+
+    The environment maps a dotted buffer name (``pool``,
+    ``self._k_pages``) to the witness chain of its donation.  Branch arms
+    run on copies and merge by union (donated on *some* path is enough);
+    rebinding the name clears it; loops run twice so a donation at the
+    bottom of the body reaches a read at the top of the next iteration."""
+
+    def __init__(self, flow: SpmdFlow, fn: FuncInfo, emit: bool) -> None:
+        self.flow = flow
+        self.fn = fn
+        self.emit = emit
+        self.path = fn.module.path
+        self.env: dict[str, tuple[str, ...]] = {}
+        self._decorators: set[int] = set()
+        for d in getattr(fn.node, "decorator_list", None) or []:
+            for sub in ast.walk(d):
+                self._decorators.add(id(sub))
+
+    def final_env(self) -> dict[str, tuple[str, ...]]:
+        return self.env
+
+    def run(self) -> None:
+        self.exec_block(self.fn.node.body, self.env)
+
+    # ----------------------------------------------------------- statements
+
+    def exec_block(self, stmts, env) -> None:
+        for stmt in stmts:
+            self.exec_stmt(stmt, env)
+
+    @staticmethod
+    def _merge(into, *branches) -> None:
+        for br in branches:
+            for key, chain in br.items():
+                into.setdefault(key, chain)
+
+    def exec_stmt(self, stmt, env) -> None:
+        if isinstance(stmt, ast.Assign):
+            self.eval(stmt.value, env)
+            for tgt in stmt.targets:
+                self._assign(tgt, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.eval(stmt.value, env)
+                self._assign(stmt.target, env)
+        elif isinstance(stmt, ast.AugAssign):
+            self.eval(stmt.target, env)  # aug-assign reads first
+            self.eval(stmt.value, env)
+            self._assign(stmt.target, env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.eval(stmt.iter, env)
+            body_env = dict(env)
+            for _ in range(2):
+                self.exec_block(stmt.body, body_env)
+            self._merge(env, body_env)
+            self.exec_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self.eval(stmt.test, env)
+            body_env = dict(env)
+            for _ in range(2):
+                self.exec_block(stmt.body, body_env)
+            self._merge(env, body_env)
+            self.exec_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test, env)
+            then_env, else_env = dict(env), dict(env)
+            self.exec_block(stmt.body, then_env)
+            self.exec_block(stmt.orelse, else_env)
+            env.clear()
+            # donated on either path survives; cleared on both paths clears
+            for key in set(then_env) | set(else_env):
+                chain = then_env.get(key) or else_env.get(key)
+                env[key] = chain
+        elif isinstance(stmt, ast.Try):
+            self.exec_block(stmt.body, env)
+            for handler in stmt.handlers:
+                h_env = dict(env)
+                self.exec_block(handler.body, h_env)
+                self._merge(env, h_env)
+            self.exec_block(stmt.orelse, env)
+            self.exec_block(stmt.finalbody, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, env)
+            self.exec_block(stmt.body, env)
+        elif isinstance(stmt, ast.Match):
+            self.eval(stmt.subject, env)
+            arms = []
+            for case in stmt.cases:
+                c_env = dict(env)
+                self.exec_block(case.body, c_env)
+                arms.append(c_env)
+            self._merge(env, *arms)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.eval(stmt.value, env)
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env)
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.eval(child, env)
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                d = dotted(tgt)
+                if d is not None:
+                    self._clear(d, env)
+
+    def _assign(self, tgt, env) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for sub in tgt.elts:
+                self._assign(sub, env)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._assign(tgt.value, env)
+            return
+        if isinstance(tgt, ast.Subscript):
+            # storing INTO a donated buffer is itself a use
+            self._check_read(tgt.value, env)
+            return
+        d = dotted(tgt)
+        if d is not None:
+            self._clear(d, env)
+
+    @staticmethod
+    def _clear(d: str, env) -> None:
+        for key in [k for k in env
+                    if k == d or k.startswith(d + ".")]:
+            del env[key]
+
+    # ---------------------------------------------------------- expressions
+
+    def eval(self, expr, env) -> None:
+        if expr is None or id(expr) in self._decorators:
+            return
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            self._check_read(expr, env)
+            return
+        if isinstance(expr, ast.Call):
+            self.eval_call(expr, env)
+            return
+        if isinstance(expr, ast.NamedExpr):
+            self.eval(expr.value, env)
+            self._assign(expr.target, env)
+            return
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, (ast.expr, ast.keyword, ast.comprehension)):
+                self.eval_child(child, env)
+
+    def eval_child(self, node, env) -> None:
+        if isinstance(node, ast.keyword):
+            self.eval(node.value, env)
+        elif isinstance(node, ast.comprehension):
+            self.eval(node.iter, env)
+            for cond in node.ifs:
+                self.eval(cond, env)
+        else:
+            self.eval(node, env)
+
+    def _check_read(self, expr, env) -> None:
+        d = dotted(expr)
+        if d is None:
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, ast.expr):
+                    self.eval(child, env)
+            return
+        for key in list(env):
+            if d == key or d.startswith(key + "."):
+                chain = env.pop(key)
+                if self.emit and (self.path, expr.lineno) in \
+                        self.flow._tpu006_lines:
+                    continue  # the per-file TPU006 already reports this read
+                if self.emit:
+                    step = (f"{d!r} read again here "
+                            f"[{self.path}:{expr.lineno}]")
+                    full = chain + (step,) if len(chain) < _MAX_CHAIN else chain
+                    self.flow.emit(
+                        self.fn, expr, "SPD002",
+                        f"use after donation: {key!r} was donated to a "
+                        f"jitted call and is read again on this path — the "
+                        f"buffer may already be deallocated/aliased on "
+                        f"device; rebind the jit's result "
+                        f"(`x = f(x)`) or drop the stale read. Witness: "
+                        + " -> ".join(full),
+                        chain=full)
+
+    def eval_call(self, call: ast.Call, env) -> None:
+        # evaluate args first: passing an already-donated buffer anywhere
+        # is a use; only afterwards does *this* call's donation take effect
+        if isinstance(call.func, ast.Attribute):
+            # a method call on a donated buffer (pool.sum()) is a read of
+            # the buffer, not of the bound method name
+            self._check_read(call.func.value, env)
+        elif not isinstance(call.func, ast.Name):
+            self.eval(call.func, env)
+        for a in call.args:
+            self.eval(a.value if isinstance(a, ast.Starred) else a, env)
+        for kw in call.keywords:
+            self.eval(kw.value, env)
+
+        spec, callee_fi, jit_name = self.flow.jit_spec_for_call(call, self.fn)
+        if spec is not None and (spec.donate_nums or spec.donate_names):
+            params: list[str] = []
+            offset = 0
+            if callee_fi is not None:
+                params = _params_of(callee_fi)
+                if params[:1] in (["self"], ["cls"]) and isinstance(
+                        call.func, ast.Attribute):
+                    offset = 1
+            for i, a in enumerate(call.args):
+                pi = i + offset
+                pname = params[pi] if pi < len(params) else None
+                if pi in spec.donate_nums or (
+                        pname is not None and pname in spec.donate_names):
+                    self._donate(a, env, (
+                        f"donated to jitted {jit_name}() "
+                        f"(donate position {pi}) "
+                        f"[{self.path}:{call.lineno}]",))
+            for kw in call.keywords:
+                if kw.arg is not None and kw.arg in spec.donate_names:
+                    self._donate(kw.value, env, (
+                        f"donated to jitted {jit_name}() "
+                        f"(donate_argnames {kw.arg!r}) "
+                        f"[{self.path}:{call.lineno}]",))
+            return
+        if spec is not None:
+            return
+        # in-repo helper with a donation summary: passing a buffer into a
+        # consumed parameter donates it here too, with the chained witness
+        for fi in self.flow._resolve(call, self.fn):
+            summary = self.flow.donation_summaries.get(id(fi))
+            if not summary:
+                continue
+            params = _params_of(fi)
+            offset = 1 if (params[:1] in (["self"], ["cls"]) and isinstance(
+                call.func, ast.Attribute)) else 0
+            for i, a in enumerate(call.args):
+                pi = i + offset
+                if pi < len(params) and params[pi] in summary:
+                    self._donate(a, env, (
+                        f"passed to {fi.name}(), which donates its "
+                        f"{params[pi]!r} parameter "
+                        f"[{self.path}:{call.lineno}]",)
+                        + summary[params[pi]])
+            for kw in call.keywords:
+                if kw.arg in summary:
+                    self._donate(kw.value, env, (
+                        f"passed to {fi.name}(), which donates its "
+                        f"{kw.arg!r} parameter "
+                        f"[{self.path}:{call.lineno}]",)
+                        + summary[kw.arg])
+
+    def _donate(self, expr, env, chain: tuple[str, ...]) -> None:
+        d = dotted(expr)
+        if d is None:
+            return
+        env.setdefault(d, chain[:_MAX_CHAIN])
+
+
+# --------------------------------------------------------------------------
+# SPD003 per-return tracker
+
+class _ReturnInterp:
+    """Branch-sensitive (variant axes, reduced axes) tracker per return.
+
+    Each variable carries the mesh axes its value still differs over
+    (``variant``) and the axes a reduction already collapsed (``reduced``).
+    Every ``return`` is checked in its own branch environment against the
+    site's resolved out_specs."""
+
+    def __init__(self, flow: SpmdFlow, site: SmapSite, body: FuncInfo,
+                 out_entries: list[SpecEntry], in_entries: list[SpecEntry],
+                 scope_reduced: set[str]) -> None:
+        self.flow = flow
+        self.site = site
+        self.body = body
+        self.out_entries = out_entries
+        self.scope_reduced = scope_reduced
+        self.path = body.module.path
+        self.env: dict[str, tuple[frozenset, frozenset]] = {}
+        params = _params_of(body)
+        partial_bound = set(site.partial_kw)
+        data_params = [p for p in params if p not in partial_bound]
+        for p, entry in zip(data_params, in_entries):
+            if entry.axes:
+                self.env[p] = (frozenset(entry.axes), frozenset())
+
+    def run(self) -> None:
+        self.exec_block(self.body.node.body, self.env)
+
+    # ----------------------------------------------------------- statements
+
+    def exec_block(self, stmts, env) -> None:
+        for stmt in stmts:
+            self.exec_stmt(stmt, env)
+
+    def exec_stmt(self, stmt, env) -> None:
+        if isinstance(stmt, ast.Assign):
+            state = self.eval(stmt.value, env)
+            for tgt in stmt.targets:
+                self._assign(tgt, stmt.value, state, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(stmt.target, stmt.value,
+                             self.eval(stmt.value, env), env)
+        elif isinstance(stmt, ast.AugAssign):
+            state = _ret_join(self.eval(stmt.target, env),
+                              self.eval(stmt.value, env))
+            self._assign(stmt.target, stmt.value, state, env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, ast.While):
+                self.eval(stmt.test, env)
+            else:
+                self.eval(stmt.iter, env)
+            body_env = dict(env)
+            for _ in range(2):
+                self.exec_block(stmt.body, body_env)
+            for key, st in body_env.items():
+                env[key] = _ret_join(env.get(key), st)
+            self.exec_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test, env)
+            then_env, else_env = dict(env), dict(env)
+            self.exec_block(stmt.body, then_env)
+            self.exec_block(stmt.orelse, else_env)
+            env.clear()
+            for key in set(then_env) | set(else_env):
+                a, b = then_env.get(key), else_env.get(key)
+                if a is None or b is None:
+                    env[key] = a or b
+                else:
+                    # optimistic at the join: variance cleared on one arm
+                    # is dropped (the arm-local return check keeps the
+                    # branch-sensitive precision); reductions accumulate
+                    env[key] = (a[0] & b[0], a[1] | b[1])
+        elif isinstance(stmt, ast.Try):
+            self.exec_block(stmt.body, env)
+            for handler in stmt.handlers:
+                h_env = dict(env)
+                self.exec_block(handler.body, h_env)
+                for key, st in h_env.items():
+                    env[key] = _ret_join(env.get(key), st)
+            self.exec_block(stmt.orelse, env)
+            self.exec_block(stmt.finalbody, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval(item.context_expr, env)
+            self.exec_block(stmt.body, env)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._check_return(stmt, env)
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env)
+
+    def _assign(self, tgt, value, state, env) -> None:
+        if isinstance(tgt, ast.Name):
+            if state is None:
+                env.pop(tgt.id, None)
+            else:
+                env[tgt.id] = state
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            if (isinstance(value, (ast.Tuple, ast.List))
+                    and len(value.elts) == len(tgt.elts)):
+                for sub_t, sub_v in zip(tgt.elts, value.elts):
+                    self._assign(sub_t, sub_v, self.eval(sub_v, env), env)
+            else:
+                for sub in tgt.elts:
+                    inner = sub.value if isinstance(sub, ast.Starred) else sub
+                    self._assign(inner, value, state, env)
+
+    # ---------------------------------------------------------- expressions
+
+    def eval(self, expr, env):
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id)
+        if isinstance(expr, ast.Constant):
+            return None
+        if isinstance(expr, ast.Call):
+            return self.eval_call(expr, env)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = None
+            for e in expr.elts:
+                out = _ret_join(out, self.eval(e, env))
+            return out
+        if isinstance(expr, ast.IfExp):
+            self.eval(expr.test, env)
+            return _ret_join(self.eval(expr.body, env),
+                             self.eval(expr.orelse, env))
+        if isinstance(expr, (ast.BinOp,)):
+            return _ret_join(self.eval(expr.left, env),
+                             self.eval(expr.right, env))
+        if isinstance(expr, ast.BoolOp):
+            out = None
+            for v in expr.values:
+                out = _ret_join(out, self.eval(v, env))
+            return out
+        if isinstance(expr, ast.UnaryOp):
+            return self.eval(expr.operand, env)
+        if isinstance(expr, ast.Compare):
+            out = self.eval(expr.left, env)
+            for c in expr.comparators:
+                out = _ret_join(out, self.eval(c, env))
+            return out
+        if isinstance(expr, ast.Subscript):
+            self.eval(expr.slice, env)
+            return self.eval(expr.value, env)
+        if isinstance(expr, ast.Attribute):
+            return self.eval(expr.value, env)
+        if isinstance(expr, ast.Starred):
+            return self.eval(expr.value, env)
+        if isinstance(expr, ast.NamedExpr):
+            state = self.eval(expr.value, env)
+            self._assign(expr.target, expr.value, state, env)
+            return state
+        return None
+
+    def eval_call(self, call: ast.Call, env):
+        name = self.flow.collective_of(call, self.body)
+        arg_states = [self.eval(a, env) for a in call.args]
+        for kw in call.keywords:
+            arg_states.append(self.eval(kw.value, env))
+        if name is not None:
+            axes = self.flow._body_axis_values(
+                self.flow.axis_expr_of(call, name), self.body, self.site)
+            base = arg_states[0] if arg_states else None
+            variant = base[0] if base else frozenset()
+            reduced = base[1] if base else frozenset()
+            if axes is None:
+                return (variant, reduced)
+            if name in _REDUCING:
+                return (variant - axes, reduced | axes)
+            if name in _GATHERING:
+                return (variant - axes, reduced)
+            if name == "axis_index":
+                return (frozenset(axes), frozenset())
+            if name in ("ppermute", "pshuffle"):
+                return (variant | axes, reduced)
+        out = None
+        for st in arg_states:
+            out = _ret_join(out, st)
+        # a function-valued argument (scan body, helper) contributes its
+        # textual collective footprint
+        for a in call.args:
+            if isinstance(a, (ast.Name, ast.Attribute)):
+                for fi in self.flow.program.resolve_callable_ref(a, self.body):
+                    red, var = self.flow.scope_axes(self.site, fi)
+                    out = _ret_join(out, (frozenset(var) - frozenset(red),
+                                          frozenset(red)))
+        return out
+
+    # ------------------------------------------------------------- returns
+
+    def _check_return(self, stmt: ast.Return, env) -> None:
+        values: list[ast.expr]
+        if isinstance(stmt.value, ast.Tuple):
+            values = list(stmt.value.elts)
+        else:
+            values = [stmt.value]
+        entries = self.out_entries
+        if len(entries) == 1 and len(values) > 1:
+            entries = entries * len(values)
+        for i, value in enumerate(values):
+            if i >= len(entries):
+                break
+            entry = entries[i]
+            state = self.eval(value, env)
+            if state is None:
+                continue
+            variant, reduced = state
+            for axis in sorted(reduced & entry.axes):
+                self.flow.emit(
+                    self.body, stmt, "SPD003",
+                    f"return value #{i} was psum-reduced over {axis!r} but "
+                    f"out_specs still partitions it over {axis!r} — the "
+                    f"replicated result gets re-scattered and each shard "
+                    f"keeps a slice of a value that is already global; "
+                    f"drop {axis!r} from out_specs or skip the reduction",
+                    chain=(self.site.step(),
+                           f"psum-reduced over {axis!r}, returned here "
+                           f"[{self.path}:{stmt.lineno}]"))
+            if not entry.known:
+                continue
+            for axis in sorted(variant - entry.axes - self.scope_reduced):
+                self.flow.emit(
+                    self.body, stmt, "SPD003",
+                    f"return value #{i} is still shard-variant over "
+                    f"{axis!r} (unreduced accumulator) but out_specs "
+                    f"treats it as replicated — each shard returns a "
+                    f"different value; psum over {axis!r} before "
+                    f"returning or partition the output over {axis!r}",
+                    chain=(self.site.step(),
+                           f"shard-variant over {axis!r}, returned here "
+                           f"[{self.path}:{stmt.lineno}]"))
+
+
+def _ret_join(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return (a[0] | b[0], a[1] | b[1])
+
+
+# --------------------------------------------------------------------------
+# registration + entry point
+
+_register_program_rule(
+    "SPD001",
+    "collective over an axis no reaching shard_map/mesh binds",
+    "A psum/pmean/all_gather/ppermute/axis_index names a mesh axis that "
+    "neither the shard_map sites reaching this code nor any Mesh "
+    "construction in the program binds. Axis arguments resolve through "
+    "axis_name= parameters, partial() bindings and call-site constants; "
+    "unresolvable axes never fire. A misspelled axis traces fine on one "
+    "device and fails only on a real mesh.",
+)
+_register_program_rule(
+    "SPD002",
+    "donated buffer read after the jitted call consumed it",
+    "A buffer passed in a donate_argnums/donate_argnames position of a "
+    "jitted call is read again on some later path. Donation lets XLA "
+    "alias the input's memory for the output, so the old reference is "
+    "dead. The rebinding idiom `x = f(x)` clears the donation; helpers "
+    "that consume a parameter propagate it to their callers, and the "
+    "finding carries the full call-chain witness.",
+)
+_register_program_rule(
+    "SPD003",
+    "reduction/out_specs mismatch in a shard_map body",
+    "A value psum-reduced over axis A is returned under an out_specs "
+    "that still partitions A (the replicated result is re-scattered), or "
+    "a shard-variant value — partitioned input or axis_index/ppermute "
+    "product — is returned under a spec that does not partition its axis "
+    "with no reduction over that axis in the body. Tracked per return "
+    "statement, branch-sensitively, plus a body-level conservation check.",
+)
+_register_program_rule(
+    "SPD004",
+    "ppermute permutation is not a total modular cyclic shift",
+    "A ppermute perm built with index arithmetic that misses the "
+    "`% axis_size` wrap (the last rank's destination falls off the "
+    "ring), uses a modulus different from the range() bound, or covers "
+    "sources/destinations unevenly. The canonical ring shift is "
+    "`[(j, (j + 1) % axis_size) for j in range(axis_size)]`.",
+)
+_register_program_rule(
+    "SPD005",
+    "shard_map body reads a closed-over device array",
+    "A shard_map body reads a module-level or enclosing-scope binding "
+    "created by jnp.zeros/arange/asarray/device_put and friends. The "
+    "trace captures the array as a constant, so every shard materializes "
+    "a full replicated copy instead of receiving its slice through "
+    "in_specs; thread it through the body's arguments instead.",
+)
+
+
+def run_spmdflow(program: Program) -> list[ProgramFinding]:
+    """Run the SPMD partition/donation pass over a built Program."""
+    return SpmdFlow(program).run()
