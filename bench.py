@@ -393,6 +393,12 @@ def _slo_overhead_pct(wall_s: float, n_steps: int, n_requests: int) -> float:
     for i in range(N):
         snap["committed_tokens"] += 8.0
         snap["decode_seconds_total"] += 1e-3
+        # fused serving steady state: every step also moves the dispatch
+        # attribution counters (fused_steps_total + step_dispatches_total
+        # feed the ledger's dispatches-per-step gauge), so the measured
+        # on_step cost covers the fused/unfused split's bookkeeping too
+        snap["fused_steps_total"] += 1.0
+        snap["step_dispatches_total"] += 1.0
         # a disagg replica's steady state: every step also moves the
         # kv_transfer accounting (snapshot diff + bucket charge + the
         # stall-minus-transfer split), so the measured on_step cost covers
@@ -1066,6 +1072,171 @@ def bench_spec_pair(tag: str, *, streams: int = 8, prompt_len: int = 32,
         f"at {acceptance:.2f} acceptance, token-identical")
     return {"speedup": speedup, "acceptance": acceptance,
             **{p: out[p] for p in out}}
+
+
+def bench_fused_pair(tag: str, *, requests: int = 64, prompt_len: int = 16,
+                     gen_tokens: int = 32, trials: int = 3) -> dict:
+    """``fused_conc64``: the fused engine step (ONE compiled launch per
+    step: packed prefill + n-gram draft + spec-verify + paged attention +
+    sampling, serving/fused_step.py) vs the unfused spec path on
+    IDENTICAL engines and the SAME mixed spec/plain traffic — the
+    serving-path A/B the acceptance gate reads.
+
+    The model is a period-8 cycle narrator (zero layers + an untied
+    lm_head whose first 8 columns score ``embed[(v-1) % 8]`` and whose
+    remaining columns are zero), so greedy output is the repeating cycle
+    0..7.  Repeating bigrams are exactly what the n-gram drafter keys
+    on: acceptance is ~1.0 and greedy rows are deterministic, so the A/B
+    isolates the dispatch-path delta — the fused step runs
+    spec_burst_iters whole iterations device-side per launch and reads
+    tokens back ONCE, while the unfused mixed batch demotes to the
+    synchronous _spec_decode_step (one program + one host round trip per
+    iteration, sampled rows committing one token each).  Half the
+    streams sample (temperature > 0) to force that demotion every step.
+
+    Gates: greedy rows token-identical across unfused/fused/fused-int4,
+    zero live XLA compiles over the timed trials, fused/unfused goodput
+    >= 1.3x at equal HBM, int4 pages >= 1.8x int8 at equal pool bytes,
+    SLO-plane overhead (including the new dispatch-attribution counters)
+    inside the 2% obs budget."""
+    import dataclasses
+    from statistics import median
+
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+    from githubrepostorag_tpu.obs.engine_profile import CompileWatchdog
+    from githubrepostorag_tpu.obs.ledger import engine_snapshot
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.kv_cache import make_page_pools
+    from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+    cfg = dataclasses.replace(Qwen2Config.tiny(), tie_word_embeddings=False)
+    p = init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    # lm_head column v scores embed[(v-1) % 8] for v < 8 and zero above,
+    # so argmax maps token t -> (t+1) % 8: every prompt seeded inside the
+    # cycle generates the cycle forever, and every bigram repeats
+    cyc = p["embed"][(jnp.arange(8) - 1) % 8]
+    lm = jnp.zeros((cfg.vocab_size, cfg.hidden_size),
+                   jnp.float32).at[:8].set(cyc)
+    params = dict(p, layers=jax.tree.map(jnp.zeros_like, p["layers"]),
+                  lm_head=lm.T)
+
+    # equal HBM on every arm: same pool geometry, same spec knobs — the
+    # ONLY deltas are the launch mode and (third arm) the page dtype
+    geom = dict(max_num_seqs=8, num_pages=96, page_size=8, max_seq_len=64,
+                prefill_chunk=16, prefill_token_budget=32, kv_dtype=jnp.float32,
+                spec_ngram_k=4, spec_burst_iters=4)
+    engines = {
+        "unfused": Engine(params, cfg, **geom),
+        "fused": Engine(params, cfg, fused_step=True, **geom),
+        "fused_int4": Engine(params, cfg, fused_step=True, kv_quant=4,
+                             **geom),
+    }
+
+    # conc64: 64 requests through 8 engine slots; prompts walk the cycle
+    # from per-stream offsets (each ends mid-cycle, so the final bigram
+    # already occurred prompt-side and drafting starts on token 1); odd
+    # streams sample, forcing the mixed-batch demotion the fused step
+    # exists to avoid
+    prompts = [[(i + j) % 8 for j in range(prompt_len)]
+               for i in range(requests)]
+    greedy = SamplingParams(max_tokens=gen_tokens, temperature=0.0,
+                            stop_token_ids=())
+    sampled = SamplingParams(max_tokens=gen_tokens, temperature=0.9,
+                             top_p=0.9, stop_token_ids=())
+    sps = [greedy if i % 2 == 0 else sampled for i in range(requests)]
+    greedy_ix = [i for i in range(requests) if i % 2 == 0]
+
+    def run(eng: Engine) -> tuple[float, float, list[list[int]]]:
+        t0 = time.monotonic()
+        res = eng.generate(prompts, sps)
+        wall = time.monotonic() - t0
+        toks = sum(len(r.output_tokens) for r in res)
+        ttfts = sorted(r.timings["first_token_t"] - r.timings["submit_t"]
+                       for r in res if "first_token_t" in r.timings)
+        p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))]
+        return toks / wall, p95, [r.output_tokens for r in res]
+
+    for eng in engines.values():
+        eng.warmup()  # the precompiled variant ladder pays compiles here
+        run(eng)  # untimed warm pass covers the exact traffic shapes
+    wd = CompileWatchdog()
+    wd.resync()
+
+    out, goodput, toks_by_path, dispatches = {}, {}, {}, {}
+    for path, eng in engines.items():
+        snap0 = engine_snapshot(eng)
+        d0, f0 = eng.step_dispatches_total, eng.fused_steps_total
+        t0 = time.monotonic()
+        samples = [run(eng) for _ in range(trials)]
+        trials_wall = time.monotonic() - t0
+        tps = median(s[0] for s in samples)
+        p95 = median(s[1] for s in samples)
+        toks_by_path[path] = samples[-1][2]
+        out[path] = (tps, p95)
+        n_disp = eng.step_dispatches_total - d0
+        n_fused = eng.fused_steps_total - f0
+        dispatches[path] = n_disp
+        ex = slo_extras(eng, snap0, trials_wall)
+        goodput[path] = ex.pop("goodput_tok_s")
+        slo_pct = _slo_overhead_pct(trials_wall, n_disp, trials * requests)
+        assert slo_pct <= 2.0, (
+            f"SLO ledger+monitor overhead {slo_pct:.2f}% of the {path} "
+            "wall exceeds the 2% obs budget (dispatch attribution "
+            "counters regressed on_step?)")
+        emit(f"{tag}_agg_tok_s_{path}", tps, "tok/s", None,
+             trial_tok_s=[round(s[0], 1) for s in samples])
+        emit(f"{tag}_ttft_p95_ms_{path}", p95 * 1e3, "ms", None)
+        emit(f"{tag}_goodput_tok_s_{path}", goodput[path], "tok/s", None,
+             dispatches=n_disp, fused_steps=n_fused,
+             slo_overhead_pct=round(slo_pct, 4), **ex)
+        log(f"bench[{tag}]: {path} {tps:.0f} tok/s agg "
+            f"(goodput {goodput[path]:.0f}), TTFT p95 {p95 * 1e3:.2f} ms, "
+            f"{n_disp} dispatches ({n_fused} fused)")
+
+    fresh = wd.sample()
+    assert fresh == 0, (
+        f"{fresh} XLA program(s) compiled during timed fused trials — the "
+        "warmup variant ladder missed a traffic shape")
+    # the tentpole's token gate: fusing the step (and packing its pages
+    # to int4) is a scheduling/layout change, never a token change
+    for path in ("fused", "fused_int4"):
+        assert [toks_by_path[path][i] for i in greedy_ix] == \
+            [toks_by_path["unfused"][i] for i in greedy_ix], \
+            f"{path} changed greedy tokens vs unfused"
+    speedup = goodput["fused"] / max(goodput["unfused"], 1e-9)
+    acceptance = (engines["fused"].spec_accepted
+                  / max(engines["fused"].spec_proposed, 1))
+    emit(f"{tag}_fused_goodput_speedup", speedup, "x", None,
+         dispatches_unfused=dispatches["unfused"],
+         dispatches_fused=dispatches["fused"])
+    emit(f"{tag}_spec_acceptance", acceptance, "ratio", None)
+    assert speedup >= 1.3, (
+        f"fused/unfused goodput {speedup:.2f}x under the 1.3x acceptance "
+        "gate")
+
+    # int4 page admission at EQUAL pool bytes: price one page in each
+    # layout (payload + per-page scales) straight from make_page_pools
+    def page_bytes(quant: int) -> int:
+        pools = make_page_pools(cfg, 1, geom["page_size"], quant=quant)
+        return sum(int(a.nbytes) for a in
+                   (pools.k, pools.v, pools.ks, pools.vs) if a is not None)
+
+    b8, b4 = page_bytes(8), page_bytes(4)
+    pages4 = geom["num_pages"] * b8 // b4
+    ratio = pages4 / geom["num_pages"]
+    emit(f"{tag}_int4_page_ratio", ratio, "x", None,
+         int8_page_bytes=b8, int4_page_bytes=b4,
+         int8_pages=geom["num_pages"], int4_pages_at_equal_bytes=pages4)
+    assert ratio >= 1.8, (
+        f"int4 admits only {ratio:.2f}x the int8 page count at equal pool "
+        "bytes (gate 1.8x)")
+    log(f"bench[{tag}]: fused/unfused goodput {speedup:.2f}x at "
+        f"{acceptance:.2f} acceptance ({dispatches['unfused']} -> "
+        f"{dispatches['fused']} dispatches), int4 pages {ratio:.2f}x int8, "
+        "greedy token-identical")
+    return {"speedup": speedup, "acceptance": acceptance,
+            "int4_ratio": ratio, "dispatches": dispatches,
+            "goodput": goodput}
 
 
 def bench_kv_tier_pair(tag: str, *, waves=(48, 48, 32), prefix_len: int = 48,
@@ -2307,6 +2478,49 @@ def _run_longctx_cpu(artifact_dir: str) -> None:
         log(f"bench: could not write BENCH_longctx_cpu.json ({exc})")
 
 
+def _run_fused_cpu(artifact_dir: str) -> None:
+    """Run the fused-step A/B and write its committed-artifact JSON.
+    Same convention as the other serving artifacts: the full CPU run
+    writes next to bench.py, BENCH_ONLY=fused CI reruns write under
+    artifacts/."""
+    if not budget_allows("fused_conc64_cpu", 240):
+        return
+    before = len(_RECORDS)
+    fs = bench_fused_pair("fused_conc64_cpu")
+    recs = _RECORDS[before:]
+    try:
+        os.makedirs(artifact_dir, exist_ok=True)
+        with open(os.path.join(artifact_dir, "BENCH_fused_cpu.json"), "w") as f:
+            json.dump({
+                "scenario": ("fused_conc64 (CPU A/B; one fused launch per "
+                             "engine step — packed prefill + spec-verify + "
+                             "paged attention + sampling — vs the unfused "
+                             "per-iteration spec path, plus an int4-KV "
+                             "fused arm)"),
+                "platform": "cpu",
+                "note": (
+                    "64 mixed spec/plain requests (half greedy, half "
+                    "sampled — the mix that demotes the unfused path to "
+                    "one synchronous program per spec iteration) through "
+                    "identical 8-slot engines at equal HBM, 3-trial "
+                    "medians. Greedy rows token-identical across "
+                    "unfused/fused/fused-int4, zero live XLA compiles, "
+                    "SLO overhead (incl. dispatch-attribution counters) "
+                    "in the 2% obs budget, all asserted. Fused/unfused "
+                    f"goodput: {fs['speedup']:.2f}x (gate 1.3x) at "
+                    f"{fs['acceptance']:.2f} acceptance, "
+                    f"{fs['dispatches']['unfused']} -> "
+                    f"{fs['dispatches']['fused']} dispatches; int4 admits "
+                    f"{fs['int4_ratio']:.2f}x the int8 page count at "
+                    "equal pool bytes (gate 1.8x)."),
+                "records": recs,
+                "summary": {r["metric"]: r["value"] for r in recs},
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        log(f"bench: could not write BENCH_fused_cpu.json ({exc})")
+
+
 def _main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -2322,7 +2536,8 @@ def _main() -> None:
                    "disagg": _run_disagg_cpu,
                    "liveindex": _run_liveindex_cpu,
                    "preempt": _run_preempt_cpu,
-                   "longctx": _run_longctx_cpu}
+                   "longctx": _run_longctx_cpu,
+                   "fused": _run_fused_cpu}
         if only not in runners:
             log(f"bench: unknown BENCH_ONLY={only!r} "
                 f"(supported: {', '.join(sorted(runners))})")
@@ -2406,6 +2621,7 @@ def _main() -> None:
         _run_liveindex_cpu(os.path.dirname(__file__) or ".")
         _run_preempt_cpu(os.path.dirname(__file__) or ".")
         _run_longctx_cpu(os.path.dirname(__file__) or ".")
+        _run_fused_cpu(os.path.dirname(__file__) or ".")
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------

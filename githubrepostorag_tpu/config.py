@@ -42,6 +42,24 @@ def _parse_quant_bits() -> int:
     )
 
 
+def _parse_kv_quant() -> int:
+    """KV_QUANT -> page bit width (0 = full precision, 8 = int8, 4 = int4
+    nibble-packed pages).  Int values stay truthiness-compatible with the
+    historical boolean knob (`if kv_quant:` sites keep working); typos
+    raise rather than silently serving full-precision pages."""
+    raw = os.environ.get("KV_QUANT", "")
+    val = str(raw).strip().lower()
+    if val in {"", "0", "false", "f", "no", "n", "off"}:
+        return 0
+    if val in {"1", "true", "t", "yes", "y", "on", "int8", "8"}:
+        return 8
+    if val in {"int4", "4"}:
+        return 4
+    raise ValueError(
+        f"KV_QUANT={raw!r} not understood; use int4, int8, or a boolean"
+    )
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
@@ -372,6 +390,15 @@ class Settings:
     spec_burst_iters: int = field(
         default_factory=lambda: _env_int("SPEC_BURST_ITERS", 0)
     )
+    # one compiled program per engine step (serving/fused_step.py): the
+    # packed prefill wave and a MIXED spec/plain decode burst dispatch
+    # together, so greedy rows keep their verify windows even when
+    # sampled rows share the batch.  Requires SPEC_NGRAM_K,
+    # SPEC_BURST_ITERS and PREFILL_TOKEN_BUDGET; incompatible with
+    # SPEC_DRAFT_MODEL and PREFILL_PRIORITY.
+    fused_step: bool = field(
+        default_factory=lambda: _env_bool("FUSED_STEP", False)
+    )
     # path to a small draft checkpoint (e.g. Qwen2.5-0.5B next to a 7B
     # target): when set, DRAFT-MODEL speculative decoding becomes the
     # serving default (serving/draft_spec.py) — draft k tokens on the
@@ -395,10 +422,15 @@ class Settings:
     spec_deadline_margin_s: float = field(
         default_factory=lambda: _env_float("SPEC_DEADLINE_MARGIN_S", 0.25)
     )
-    # int8 KV cache pages with per-token dequant scales: halves KV reads
-    # and doubles effective page capacity (kv_cache.quantize_kv_paged:
-    # per-page scales riding the decode kernel's scalar-prefetch channel)
-    kv_quant: bool = field(default_factory=lambda: _env_bool("KV_QUANT", False))
+    # quantized KV cache pages with per-page dequant scales
+    # (kv_cache.quantize_kv_paged; scales ride the decode kernel's
+    # scalar-prefetch channel).  KV_QUANT=int8 (or any truthy boolean)
+    # halves KV reads and doubles effective page capacity; KV_QUANT=int4
+    # nibble-packs two head components per byte (ops/fused_decode.py
+    # dequantizes in-kernel) for ~4x the bf16 page count at equal HBM.
+    # 0 = off, 8 = int8, 4 = int4 — int is truthiness-compatible with the
+    # historical bool.
+    kv_quant: int = field(default_factory=_parse_kv_quant)
     # host-RAM KV page tier (serving/kv_cache.TieredPageAllocator): cold
     # registered prefix pages write back to host RAM at step boundaries
     # and fault back in on re-admission, so the prefix cache extends past

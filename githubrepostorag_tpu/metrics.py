@@ -294,6 +294,20 @@ LEDGER_TOKENS = Counter(
     ["replica", "outcome"],
     registry=REGISTRY,
 )
+ENGINE_FUSED_STEPS = Counter(
+    "rag_engine_fused_steps_total",
+    "Engine steps served by the single-dispatch fused program "
+    "(packed prefill + mixed spec/plain decode — serving/fused_step.py)",
+    ["replica"],
+    registry=REGISTRY,
+)
+ENGINE_STEP_DISPATCHES = Gauge(
+    "rag_engine_step_dispatches",
+    "Rolling main-model programs dispatched per engine step (1.0 = every "
+    "step fused into one program; the unfused mixed path issues 2+)",
+    ["replica"],
+    registry=REGISTRY,
+)
 SLO_BURN = Gauge(
     "rag_slo_burn_rate",
     "Error-budget burn rate per objective/class over each rolling window",

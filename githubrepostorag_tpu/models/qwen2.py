@@ -452,14 +452,16 @@ def forward_paged_impl(
     from githubrepostorag_tpu.ops.paged_attention import paged_attention_ref
 
     quant = k_scales is not None
-    if use_pallas and not quant:
-        from githubrepostorag_tpu.ops.pallas_paged import paged_attention as attn_fn
+    if use_pallas:
+        # ONE kernel for every window shape and pool precision: spec
+        # verify (S = k+1), plain decode (S = 1), fp/int8/int4 pages all
+        # run ops/fused_decode's flash window kernel — the old dispatcher
+        # routed S > 1 and quantized pools to the materialized gather_kv
+        # fallback, a full [B, mp*ps, n_kv, hd] HBM copy per layer.
+        from githubrepostorag_tpu.ops.fused_decode import (
+            fused_paged_attention as attn_fn,
+        )
     else:
-        # kv_quant: the ref/gather path with dequant.  Not a hot-path
-        # regression: forward_paged serves prefill chunks and spec
-        # verification, both S > 1 — shapes the pallas dispatcher routes
-        # to the gather path anyway; decode (S == 1) always runs in
-        # decode_burst, whose staged kernel reads int8 pages natively.
         attn_fn = paged_attention_ref
 
     b, s = input_ids.shape
@@ -573,6 +575,37 @@ def forward_paged_packed(
     (logits [R, 1, V], k_pages, v_pages[, k_scales, v_scales]) — logits
     are per SEGMENT at each segment's last packed position, so the engine's
     [row-bucket] sampling program is unchanged."""
+    return forward_paged_packed_impl(
+        params, cfg, input_ids, positions, k_pages, v_pages, slot_mapping,
+        block_tables, cached_lens, new_lens, seg_ids, logits_at, tq,
+        use_pallas, k_scales=k_scales, v_scales=v_scales,
+        int4_kernel=int4_kernel,
+    )
+
+
+def forward_paged_packed_impl(
+    params: dict,
+    cfg: Qwen2Config,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    slot_mapping: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    cached_lens: jnp.ndarray,
+    new_lens: jnp.ndarray,
+    seg_ids: jnp.ndarray,
+    logits_at: jnp.ndarray,
+    tq: int,
+    use_pallas: bool = False,
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
+    int4_kernel: bool = True,
+):
+    """Unjitted body of ``forward_paged_packed`` so larger fused programs
+    (serving/fused_step.py's one-dispatch prefill+decode step) can inline
+    the packed phase without nested-jit donation clashes — the same split
+    as forward_paged/forward_paged_impl."""
     from githubrepostorag_tpu.ops.packed_prefill import packed_prefill_attention
 
     quant = k_scales is not None

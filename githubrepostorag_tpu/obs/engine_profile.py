@@ -47,11 +47,13 @@ DEFAULT_JIT_MODULES = (
     "githubrepostorag_tpu.serving.engine",
     "githubrepostorag_tpu.serving.decode_burst",
     "githubrepostorag_tpu.serving.spec_burst",
+    "githubrepostorag_tpu.serving.fused_step",
     "githubrepostorag_tpu.serving.draft_spec",
     "githubrepostorag_tpu.serving.long_prefill",
     "githubrepostorag_tpu.models.qwen2",
     "githubrepostorag_tpu.ops.sampling",
     "githubrepostorag_tpu.ops.packed_prefill",
+    "githubrepostorag_tpu.ops.fused_decode",
     "githubrepostorag_tpu.ops.page_migration",
 )
 

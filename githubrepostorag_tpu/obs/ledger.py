@@ -65,6 +65,7 @@ SNAPSHOT_FIELDS = (
     "spec_verify_seconds_total",
     "migration_seconds_total", "fault_in_seconds_total",
     "transfer_seconds_total",
+    "fused_steps_total", "step_dispatches_total",
 )
 
 
@@ -117,6 +118,9 @@ class TokenLedger:
         self._m_mfu = metrics.LEDGER_MFU.labels(replica=replica)
         self._m_limiter = {lim: metrics.LEDGER_LIMITER.labels(
             replica=replica, limiter=lim) for lim in LIMITERS}
+        self._m_fused = metrics.ENGINE_FUSED_STEPS.labels(replica=replica)
+        self._m_dispatches = metrics.ENGINE_STEP_DISPATCHES.labels(
+            replica=replica)
 
     # ------------------------------------------------------------ feeding --
 
@@ -155,6 +159,11 @@ class TokenLedger:
                 "compiles": float(compiles),
                 "wall": wall,
                 "steps": 1.0,
+                # dispatch attribution: how many main-model programs this
+                # step issued, and whether the fused single-dispatch
+                # program served it (serving/fused_step.py)
+                "fused_steps": max(0.0, d["fused_steps_total"]),
+                "dispatches": max(0.0, d["step_dispatches_total"]),
             }
             if compiles > 0:
                 # kv_transfer stays out of ``measured``: it is inter-step
@@ -164,7 +173,7 @@ class TokenLedger:
                 rec["compile"] = max(0.0, wall - measured)
 
             self._append(step_end, rec)
-            for k in BUCKETS + OUTCOMES:
+            for k in BUCKETS + OUTCOMES + ("fused_steps",):
                 if rec[k] > 0:
                     self._pending[k] = self._pending.get(k, 0.0) + rec[k]
             if step_end - self._last_pub >= _PUBLISH_S:
@@ -190,6 +199,9 @@ class TokenLedger:
             v = self._pending.pop(o, 0.0)
             if v > 0:
                 self._m_tok[o].inc(v)
+        v = self._pending.pop("fused_steps", 0.0)
+        if v > 0:
+            self._m_fused.inc(v)
         self._publish_locked(now)
         self._last_pub = now
 
@@ -245,6 +257,9 @@ class TokenLedger:
         limiter = self._limiter_locked(now)
         self._m_goodput.set(goodput)
         self._m_mfu.set(mfu)
+        steps = self._sums.get("steps", 0.0)
+        self._m_dispatches.set(
+            self._sums.get("dispatches", 0.0) / steps if steps else 0.0)
         for lim, g in self._m_limiter.items():
             g.set(1.0 if lim == limiter else 0.0)
         self._last = (goodput, mfu, limiter)
@@ -290,4 +305,11 @@ class TokenLedger:
                         wasted / max(1.0, committed + wasted), 6),
                 },
                 "bucket_seconds": {b: round(s.get(b, 0.0), 6) for b in BUCKETS},
+                "dispatch": {
+                    "fused_steps": int(s.get("fused_steps", 0.0)),
+                    "dispatches": int(s.get("dispatches", 0.0)),
+                    "dispatches_per_step": round(
+                        s.get("dispatches", 0.0) / s.get("steps", 1.0)
+                        if s.get("steps", 0.0) else 0.0, 6),
+                },
             }

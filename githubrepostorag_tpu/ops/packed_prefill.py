@@ -228,19 +228,27 @@ def packed_prefill_attention(
     """Packed-buffer entry point (see module docstring for the contract).
 
     Scatters the packed queries into the segment-major [R, tq] view, runs
-    segment-masked attention there (Pallas when ``use_pallas`` and the
-    pools are full precision — the kernel reads pages natively; kv_quant
-    pools take the gather path with per-page dequant, same rule as
-    forward_paged), and gathers the outputs back to packed order."""
+    segment-masked attention there (Pallas when ``use_pallas``: the seg
+    kernel for full-precision pools, the fused window kernel
+    (ops/fused_decode.py) for quantized pools — int8/int4 pages
+    dequantize in-register instead of taking the materialized gather
+    path), and gathers the outputs back to packed order."""
     t, n_q, hd = q.shape
     r = block_tables.shape[0]
+    quant = k_scales is not None
+    if use_pallas and quant:
+        from githubrepostorag_tpu.ops.fused_decode import fused_packed_attention
+
+        return fused_packed_attention(
+            q, k_pages, v_pages, block_tables, cached_lens, new_lens,
+            seg_ids, positions, tq=tq, k_scales=k_scales, v_scales=v_scales,
+        )
     dest = _segment_scatter_indices(seg_ids, positions, cached_lens, tq)
     q_seg = (
         jnp.zeros((r * tq, n_q, hd), q.dtype)
         .at[dest].set(q, mode="drop")
         .reshape(r, tq, n_q, hd)
     )
-    quant = k_scales is not None
     if use_pallas and not quant:
         interpret = jax.default_backend() != "tpu"
         out_seg = packed_prefill_attention_seg(

@@ -32,15 +32,22 @@ def gather_kv(k_pages, v_pages, block_tables, k_scales=None, v_scales=None,
     """[n_kv, P, ps, hd] + [B, max_pages] -> [B, max_pages*ps, n_kv, hd].
 
     With ``k_scales``/``v_scales`` ([n_kv, P] per-PAGE dequant scales,
-    kv_quant pools — kv_cache.quantize_kv_paged) the gathered int8 pages
-    dequantize to ``dtype`` (default bf16) on the way out."""
+    kv_quant pools — kv_cache.quantize_kv_paged) the gathered quantized
+    pages dequantize to ``dtype`` (default bf16) on the way out.  uint8
+    pools are nibble-packed int4 (kv_cache.pack_int4): the gathered bytes
+    unpack to the full head width before the scale multiply, so this stays
+    the bit-exact oracle for the fused kernel's in-register dequant."""
     b, max_pages = block_tables.shape
-    n_kv, _, ps, hd = k_pages.shape
+    n_kv, _, ps, hd_store = k_pages.shape
 
     def gather(pages, scales):
-        g = pages[:, block_tables]  # [n_kv, B, max_pages, ps, hd]
-        g = jnp.moveaxis(g, 0, 3)  # [B, max_pages, ps, n_kv, hd]
-        g = g.reshape(b, max_pages * ps, n_kv, hd)
+        g = pages[:, block_tables]  # [n_kv, B, max_pages, ps, hd_store]
+        g = jnp.moveaxis(g, 0, 3)  # [B, max_pages, ps, n_kv, hd_store]
+        if pages.dtype == jnp.uint8:
+            from githubrepostorag_tpu.serving.kv_cache import unpack_int4
+
+            g = unpack_int4(g)  # [..., hd_store] uint8 -> [..., hd] int8
+        g = g.reshape(b, max_pages * ps, n_kv, g.shape[-1])
         if scales is None:
             return g
         s = jnp.moveaxis(scales[:, block_tables], 0, 2)  # [B, mp, n_kv]

@@ -19,6 +19,20 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _segment_logits(logits: jnp.ndarray, seg_pos=None) -> jnp.ndarray:
+    """Accept the fused kernel's per-segment [B, S, V] logits layout
+    directly: ``seg_pos`` ([B] int32) gathers each row's window position
+    on device (None = position 0, the committed token of a verify/decode
+    window).  Rank-2 logits pass through untouched — callers used to
+    transpose-copy [B, S, V] windows host-side before sampling; the
+    on-device take_along_axis fuses into the sampling program instead."""
+    if logits.ndim == 2:
+        return logits
+    if seg_pos is None:
+        return logits[:, 0]
+    return jnp.take_along_axis(logits, seg_pos[:, None, None], axis=1)[:, 0]
+
+
 def _exact_topk() -> bool:
     """SAMPLING_EXACT_TOPK=1 -> exact full-vocab candidate selection in
     sample_tokens_capped (read per trace, so flipping the env between
@@ -68,7 +82,7 @@ def top_p_mask(logits: jnp.ndarray, p: jnp.ndarray | float) -> jnp.ndarray:
 
 
 def sample_tokens_capped(
-    logits: jnp.ndarray,  # [B, V] float32
+    logits: jnp.ndarray,  # [B, V] float32, or [B, S, V] fused-window layout
     rng: jax.Array,
     temperature: jnp.ndarray,  # [B] — 0 means greedy
     top_p: jnp.ndarray,  # [B] — 1.0 disables
@@ -76,6 +90,8 @@ def sample_tokens_capped(
     repetition_penalty: jnp.ndarray,  # [B]
     presence: jnp.ndarray,  # [B, V] bool
     cap: int = 128,
+    seg_pos: jnp.ndarray | None = None,  # [B] window position per row
+    # (rank-3 logits only; None = position 0)
 ) -> jnp.ndarray:
     """Decode-loop sampler: identical semantics to ``sample_tokens`` except
     top-k/top-p operate within the ``cap`` highest logits.  The candidate
@@ -96,6 +112,7 @@ def sample_tokens_capped(
     reproducibility-sensitive evals where the ~(1-recall)-per-step chance
     of a missing tail candidate matters more than the ~15%
     decode-throughput cost."""
+    logits = _segment_logits(logits, seg_pos)
     logits = apply_repetition_penalty(logits, presence, repetition_penalty[:, None])
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -142,11 +159,12 @@ def sample_tokens_capped(
 
 
 def sample_tokens_nofilter(
-    logits: jnp.ndarray,  # [B, V] float32
+    logits: jnp.ndarray,  # [B, V] float32, or [B, S, V] fused-window layout
     rng: jax.Array,
     temperature: jnp.ndarray,  # [B] — 0 means greedy
     repetition_penalty: jnp.ndarray,  # [B]
     presence: jnp.ndarray,  # [B, V] bool
+    seg_pos: jnp.ndarray | None = None,  # [B] window position per row
 ) -> jnp.ndarray:
     """Sampling fast path for rows with top_p >= 1 and top_k <= 0 (the
     default API sampling config): ``jax.random.categorical`` over the full
@@ -164,6 +182,7 @@ def sample_tokens_nofilter(
     logits, negligible at practical temperatures, and batch composition
     already shifts per-row draws (rows index a shared step key), so no
     cross-composition reproducibility is lost that ever existed."""
+    logits = _segment_logits(logits, seg_pos)
     logits = apply_repetition_penalty(logits, presence, repetition_penalty[:, None])
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]

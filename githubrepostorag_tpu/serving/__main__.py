@@ -147,6 +147,7 @@ async def serve(host: str, port: int) -> None:
             sp_ring_buckets=s.sp_ring_buckets,
             spec_ngram_k=s.spec_ngram_k,
             spec_burst_iters=s.spec_burst_iters,
+            fused_step=s.fused_step,
             draft_params=draft_params,
             draft_cfg=draft_cfg,
             spec_k=s.spec_k,

@@ -149,6 +149,11 @@ def decode_burst(
     rows = jnp.arange(b)
     start_lens = seq_lens  # pool validity is frozen for the whole burst
     quant = k_scales is not None
+    # int4 pools (uint8, kv_cache.pack_int4): the staged kernel reads int8
+    # pages natively but has no nibble path — bursts over int4 pages take
+    # the gather fallback, whose gather_kv unpacks and dequantizes.  The
+    # fused step path (serving/fused_step.py) is the int4 hot path.
+    use_pallas = use_pallas and k_pages.dtype != jnp.uint8
     # staged tail stays full precision even over int8 pools — it is tiny
     # (MBs) and fresh tokens re-read every step; only the committed pages
     # carry the int8 + per-token-scale representation.  Full precision

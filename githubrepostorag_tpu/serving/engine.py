@@ -56,6 +56,7 @@ from githubrepostorag_tpu.serving.kv_cache import (
     packed_slot_mapping,
     page_hashes,
     pages_needed,
+    quant_bits,
     slot_mapping,
 )
 from githubrepostorag_tpu.serving.sampling_params import SamplingParams
@@ -209,10 +210,12 @@ class Engine:
         # compiled prefill shape per row bucket (the width-bucket zoo
         # collapses; ``prefill_widths`` is ignored).  None = padded path.
         kv_dtype=jnp.bfloat16,
-        kv_quant: bool = False,  # int8 KV pages with per-page scales —
-        # halves cache reads and doubles page capacity
-        # (kv_cache.quantize_kv_paged; scales ride the decode kernel's
-        # scalar-prefetch channel, costing zero extra operand DMAs)
+        kv_quant: bool | int = False,  # quantized KV pages with per-page
+        # scales (kv_cache.quantize_kv_paged; scales ride the decode
+        # kernel's scalar-prefetch channel, costing zero extra operand
+        # DMAs).  True/8 = int8 (halves cache reads, doubles page
+        # capacity); 4 = nibble-packed int4 (ops/fused_decode.py
+        # dequantizes in-kernel; ~4x the bf16 page count at equal HBM)
         use_pallas: bool = False,
         rng_seed: int = 0,
         decode_burst: int = 8,
@@ -266,6 +269,15 @@ class Engine:
         # (serving/spec_burst.py) whenever every running row is plain
         # greedy — removes the per-verify dispatch round trip that made
         # host-dispatched spec decode a measured loss (BENCH r03/r04)
+        fused_step: bool = False,  # FUSED_STEP: one compiled program per
+        # engine step (serving/fused_step.py) — the packed prefill wave
+        # and a MIXED spec/plain decode burst dispatch together, so
+        # greedy rows keep their verify windows even when sampled rows
+        # share the batch (the unfused all-greedy gate demotes such
+        # batches to plain decode).  Requires spec_ngram_k > 0,
+        # spec_burst_iters > 0, prefill_token_budget set, no draft model
+        # and no prefill_priority (a skipped decode step would orphan
+        # the deferred prefill wave).
         draft_params: dict | None = None,  # DRAFT-MODEL speculation (the
         # default serving path when set — SPEC_DRAFT_MODEL): a second,
         # small model drafts k tokens autoregressively on its own KV
@@ -359,13 +371,15 @@ class Engine:
         self.decode_burst = max(1, decode_burst)
         self.layer_unroll = max(1, layer_unroll)
 
-        self.kv_quant = kv_quant
+        # normalized bit width: 0 off, 8 int8, 4 nibble-packed int4 — all
+        # historical `if self.kv_quant:` truthiness sites keep working
+        self.kv_quant = quant_bits(kv_quant)
         # int4 weights route to the Pallas GEMM only when unsharded (an
         # opaque pallas_call has no GSPMD partitioning rule); TP meshes
         # take the partitionable XLA formulation (quant.Layered4XLA)
         self._int4_kernel = mesh is None or mesh.shape.get("tp", 1) == 1
         pools = make_page_pools(cfg, num_pages, page_size, dtype=kv_dtype,
-                                quant=kv_quant)
+                                quant=self.kv_quant)
         self._k_pages, self._v_pages = pools.k, pools.v
         self._k_scales, self._v_scales = pools.ks, pools.vs
         if mesh is not None:
@@ -375,7 +389,7 @@ class Engine:
             kv_sharding = NamedSharding(mesh, PS(None, kv_tp, None, None, None))
             self._k_pages = jax.device_put(self._k_pages, kv_sharding)
             self._v_pages = jax.device_put(self._v_pages, kv_sharding)
-            if kv_quant:
+            if self.kv_quant:
                 # per-page scales [L, n_kv, P]: sharded with the kv-head axis
                 s_sharding = NamedSharding(mesh, PS(None, kv_tp, None))
                 self._k_scales = jax.device_put(self._k_scales, s_sharding)
@@ -456,6 +470,43 @@ class Engine:
                 "SPEC_NGRAM_K it would silently do nothing)"
             )
         self.spec_burst_iters = spec_burst_iters
+        if fused_step:
+            # fail fast on inert/unsafe combos rather than silently
+            # falling back: the fused step IS the serving mode the
+            # operator asked for
+            if spec_ngram_k <= 0 or spec_burst_iters <= 0:
+                raise ValueError(
+                    "fused_step requires spec_ngram_k > 0 and "
+                    "spec_burst_iters > 0 (FUSED_STEP fuses the n-gram "
+                    "spec burst with packed prefill)"
+                )
+            if prefill_token_budget is None:
+                raise ValueError(
+                    "fused_step requires prefill_token_budget (the fused "
+                    "program's prefill phase is the packed segment grid)"
+                )
+            if draft_params is not None:
+                raise ValueError(
+                    "fused_step and draft-model speculation are mutually "
+                    "exclusive; unset SPEC_DRAFT_MODEL or FUSED_STEP"
+                )
+            if prefill_priority:
+                raise ValueError(
+                    "fused_step is incompatible with prefill_priority: a "
+                    "prefill-priority step skips decode, which would "
+                    "orphan the deferred prefill wave"
+                )
+        self.fused_step_on = bool(fused_step)
+        # fixed segment-row bucket of the fused program's prefill phase:
+        # the largest packed bucket, so the compiled fused-variant set is
+        # (decode row bucket) x (has_prefill) x (filter_sampling) — wave
+        # composition never mints a new prefill shape mid-traffic
+        self._fused_pf_segs = (
+            self.packed_prefill_buckets()[-1] if self.fused_step_on else 0
+        )
+        self._fused_pf_wave: dict | None = None  # deferred packed wave
+        self.fused_steps_total = 0  # stats: fused single-dispatch steps
+        self.step_dispatches_total = 0  # stats: main-model programs issued
 
         # ---- draft-model speculation (the default serving path when a
         # draft is configured — serving/draft_spec.py) ----
@@ -741,15 +792,23 @@ class Engine:
                     spec_path = False
                     self._decode_step(finished)
             elif self.spec_ngram_k > 0:
-                all_greedy = all(
-                    r.sampling.temperature <= 0.0
-                    and r.sampling.repetition_penalty == 1.0
-                    for r in running
-                )
-                if self.spec_burst_iters > 0 and all_greedy:
-                    self._spec_burst_step(finished)
+                if self.fused_step_on:
+                    # one compiled program for the whole step: the packed
+                    # prefill wave _try_prefill deferred (if any) plus a
+                    # MIXED spec/plain burst — greedy rows keep their
+                    # verify windows even when sampled rows share the
+                    # batch (serving/fused_step.py)
+                    self._fused_step(finished)
                 else:
-                    self._spec_decode_step(finished)
+                    all_greedy = all(
+                        r.sampling.temperature <= 0.0
+                        and r.sampling.repetition_penalty == 1.0
+                        for r in running
+                    )
+                    if self.spec_burst_iters > 0 and all_greedy:
+                        self._spec_burst_step(finished)
+                    else:
+                        self._spec_decode_step(finished)
             else:
                 spec_path = False
                 self._decode_step(finished)
@@ -1054,7 +1113,9 @@ class Engine:
         if not staged:
             return
         t0 = time.monotonic()
-        ps, hd = self.page_size, self.cfg.head_dim
+        # stored head width comes from the pool, not the config: int4
+        # pages nibble-pack two components per byte (head_dim // 2)
+        ps, hd = self.page_size, self._k_pages.shape[-1]
         L, n_kv = self.cfg.num_layers, self.cfg.num_kv_heads
         quant = self._k_scales is not None
         while staged:
@@ -1544,6 +1605,7 @@ class Engine:
         slots_d, bt_d = jnp.asarray(slots), jnp.asarray(bt)
         cached_d, new_lens_d = jnp.asarray(cached), jnp.asarray(new_lens)
         last_idx_d = jnp.asarray(last_idx)
+        self.step_dispatches_total += 1
         with annotate("engine.prefill_batch"):
             out = forward_paged(
                 self.params, self.cfg,
@@ -1566,6 +1628,7 @@ class Engine:
             # construction), so decode-time drafting always has the full
             # prompt in its cache.  Logits are discarded; the call exists
             # for its KV writes.
+            self.step_dispatches_total += 1
             with annotate("engine.prefill_batch_draft"):
                 _, self._dk_pages, self._dv_pages = forward_paged(
                     self.draft_params, self.draft_cfg,
@@ -1651,6 +1714,69 @@ class Engine:
         exactly one program per bucket in packed_prefill_buckets() —
         warmup() compiles each, live traffic adds none."""
         others_running = any(r.state == "running" for r in self._row_req.values())
+        if self.fused_step_on and others_running:
+            # decode rows are live: DEFER this wave — step()'s decode
+            # branch fuses it into the same compiled program as the burst
+            # (serving/fused_step.py _fused_step), always at the fixed
+            # ``_fused_pf_segs`` segment bucket so wave composition never
+            # mints a new fused shape.  All bookkeeping (advance,
+            # presence, first tokens) runs after that single dispatch.
+            self._fused_pf_wave = self._build_packed_wave(
+                reqs, rb=self._fused_pf_segs
+            )
+            return
+        meta = self._build_packed_wave(reqs)
+
+        ids_d, pos_d = jnp.asarray(meta["ids"]), jnp.asarray(meta["pos"])
+        slots_d, bt_d = jnp.asarray(meta["slots"]), jnp.asarray(meta["bt"])
+        cached_d = jnp.asarray(meta["cached"])
+        new_lens_d = jnp.asarray(meta["new_lens"])
+        seg_d, last_idx_d = jnp.asarray(meta["seg"]), jnp.asarray(meta["last_idx"])
+        tq = self.packed_chunk
+        self.step_dispatches_total += 1
+        with annotate("engine.prefill_packed"):
+            out = forward_paged_packed(
+                self.params, self.cfg,
+                ids_d, pos_d,
+                self._k_pages, self._v_pages,
+                slots_d, bt_d,
+                cached_d, new_lens_d,
+                seg_d, last_idx_d,
+                tq=tq, use_pallas=self.use_pallas,
+                k_scales=self._k_scales, v_scales=self._v_scales,
+                int4_kernel=self._int4_kernel,
+            )
+            if self.kv_quant:
+                (logits, self._k_pages, self._v_pages,
+                 self._k_scales, self._v_scales) = out
+            else:
+                logits, self._k_pages, self._v_pages = out
+        if self._draft_enabled:
+            # mirror the packed chunk into the draft pools (see
+            # _prefill_batch) — same packed buffer, same segment IDs
+            self.step_dispatches_total += 1
+            with annotate("engine.prefill_packed_draft"):
+                _, self._dk_pages, self._dv_pages = forward_paged_packed(
+                    self.draft_params, self.draft_cfg,
+                    ids_d, pos_d,
+                    self._dk_pages, self._dv_pages,
+                    slots_d, bt_d,
+                    cached_d, new_lens_d,
+                    seg_d, last_idx_d,
+                    tq=tq, use_pallas=self.use_pallas,
+                    int4_kernel=self._int4_kernel,
+                )
+        self._finish_packed_wave(meta, logits, finished, others_running)
+
+    def _build_packed_wave(
+        self, reqs: list[_Request], rb: int | None = None
+    ) -> dict:
+        """Greedy-pack the prefilling rows' next chunks into the [budget]
+        token buffer and build every host array the packed program needs.
+        ``rb`` pins the segment-row bucket (the fused step always builds
+        at ``_fused_pf_segs``); None buckets the actual segment count.
+        Pure array construction — the caller dispatches and then runs
+        ``_finish_packed_wave`` for the bookkeeping."""
         budget = self.prefill_token_budget
         tq = self.packed_chunk
         packed: list[tuple[_Request, int]] = []  # (request, tokens granted)
@@ -1662,7 +1788,8 @@ class Engine:
             packed.append((req, share))
             used += share
         n = len(packed)
-        rb = _bucket(n, self.max_num_seqs, minimum=1)
+        if rb is None:
+            rb = _bucket(n, self.max_num_seqs, minimum=1)
 
         ids = np.zeros((1, budget), dtype=np.int32)
         pos = np.zeros((1, budget), dtype=np.int32)
@@ -1694,49 +1821,32 @@ class Engine:
             off += share
         self.packed_prefill_tokens += used
         self.packed_prefill_padding += budget - used
-
-        ids_d, pos_d = jnp.asarray(ids), jnp.asarray(pos)
-        slots_d, bt_d = jnp.asarray(slots), jnp.asarray(bt)
-        cached_d, new_lens_d = jnp.asarray(cached), jnp.asarray(new_lens)
-        seg_d, last_idx_d = jnp.asarray(seg), jnp.asarray(last_idx)
-        with annotate("engine.prefill_packed"):
-            out = forward_paged_packed(
-                self.params, self.cfg,
-                ids_d, pos_d,
-                self._k_pages, self._v_pages,
-                slots_d, bt_d,
-                cached_d, new_lens_d,
-                seg_d, last_idx_d,
-                tq=tq, use_pallas=self.use_pallas,
-                k_scales=self._k_scales, v_scales=self._v_scales,
-                int4_kernel=self._int4_kernel,
-            )
-            if self.kv_quant:
-                (logits, self._k_pages, self._v_pages,
-                 self._k_scales, self._v_scales) = out
-            else:
-                logits, self._k_pages, self._v_pages = out
-        if self._draft_enabled:
-            # mirror the packed chunk into the draft pools (see
-            # _prefill_batch) — same packed buffer, same segment IDs
-            with annotate("engine.prefill_packed_draft"):
-                _, self._dk_pages, self._dv_pages = forward_paged_packed(
-                    self.draft_params, self.draft_cfg,
-                    ids_d, pos_d,
-                    self._dk_pages, self._dv_pages,
-                    slots_d, bt_d,
-                    cached_d, new_lens_d,
-                    seg_d, last_idx_d,
-                    tq=tq, use_pallas=self.use_pallas,
-                    int4_kernel=self._int4_kernel,
-                )
-
         row_idx = np.zeros((rb,), dtype=np.int32)
         row_idx[:n] = [req.row for req, _ in packed]
-        row_d = jnp.asarray(row_idx)
+        return {
+            "packed": packed, "rb": rb, "ids": ids, "pos": pos,
+            "slots": slots, "seg": seg, "bt": bt, "cached": cached,
+            "new_lens": new_lens, "last_idx": last_idx,
+            "seg_ids_2d": seg_ids_2d, "row_idx": row_idx,
+        }
+
+    def _finish_packed_wave(
+        self,
+        meta: dict,
+        logits: jnp.ndarray,  # [rb, 1, V] per-segment last-position logits
+        finished: list[GenerationResult],
+        others_running: bool,
+    ) -> None:
+        """Post-dispatch bookkeeping for a packed prefill wave: presence
+        marks, per-request advance/page registration, and first-token
+        sampling for rows whose prompt completed.  Shared verbatim between
+        the standalone packed dispatch and the fused step (which runs it
+        on the fused program's returned prefill logits)."""
+        packed, rb = meta["packed"], meta["rb"]
+        row_d = jnp.asarray(meta["row_idx"])
         self._presence = _mark_presence_chunks(
-            self._presence, row_d, jnp.asarray(seg_ids_2d),
-            jnp.asarray(new_lens), self.cfg.vocab_size,
+            self._presence, row_d, jnp.asarray(meta["seg_ids_2d"]),
+            jnp.asarray(meta["new_lens"]), self.cfg.vocab_size,
         )
 
         done_idx: list[int] = []
@@ -1792,6 +1902,7 @@ class Engine:
         slots = slot_mapping(
             self._block_tables[req.row], 0, n, self.page_size, width
         )[None]
+        self.step_dispatches_total += 1
         with annotate("engine.sp_prefill"):
             (logits, self._k_pages, self._v_pages,
              self._k_scales, self._v_scales) = ring_prefill(
@@ -1890,6 +2001,7 @@ class Engine:
         self.sp_ring_padding += width - total
         self.prefill_tokens += total
 
+        self.step_dispatches_total += 1
         with annotate("engine.sp_prefill_packed"):
             (logits, self._k_pages, self._v_pages,
              self._k_scales, self._v_scales) = ring_prefill_packed(
@@ -2010,6 +2122,7 @@ class Engine:
         self._push_sampling()
         self._rng, key = jax.random.split(self._rng)
 
+        self.step_dispatches_total += 1
         with annotate("engine.decode_burst"):
             out = decode_burst(
                 self.params, self.cfg,
@@ -2075,6 +2188,7 @@ class Engine:
             limits[i] = self._row_limits[req.row]
             active[i] = True
 
+        self.step_dispatches_total += 1
         with annotate("engine.spec_burst"):
             out = spec_decode_burst(
                 self.params, self.cfg,
@@ -2107,6 +2221,114 @@ class Engine:
                     self._commit_token(req, int(t), finished)
                     committed += 1
                 if committed:
+                    # committed = agreed draft prefix + 1 correction token
+                    self.spec_accepted += committed - 1
+
+    def _fused_step(self, finished: list[GenerationResult]) -> None:
+        """ONE compiled program for the whole step (serving/fused_step.py):
+        the packed prefill wave _prefill_batch_packed deferred (if any)
+        runs as phase A, then ``spec_burst_iters`` MIXED decode iterations
+        — greedy rows draft/verify/accept exactly like _spec_burst_step
+        (token-identical by construction), sampled rows draw one on-device
+        token per iteration from the same forward instead of demoting the
+        batch to plain decode.  Commit bookkeeping stays host-side on the
+        returned token block; the deferred wave's bookkeeping
+        (_finish_packed_wave) runs on the returned prefill logits, so rows
+        finishing prefill join the NEXT step's burst."""
+        from githubrepostorag_tpu.serving.fused_step import fused_step_burst
+
+        k = self.spec_ngram_k
+        running = [r for r in self._row_req.values() if r.state == "running"]
+        rb = _bucket(len(running), self.max_num_seqs, minimum=1)
+        h = self.max_seq_len
+        hist = np.zeros((rb, h), dtype=np.int32)
+        hlens = np.zeros((rb,), dtype=np.int32)
+        lens = np.zeros((rb,), dtype=np.int32)
+        bt = np.zeros((rb, self.max_pages_per_seq), dtype=np.int32)
+        limits = np.zeros((rb,), dtype=np.int32)
+        active = np.zeros((rb,), dtype=bool)
+        spec_ok = np.zeros((rb,), dtype=bool)
+        row_idx = np.zeros((rb,), dtype=np.int32)
+        for i, req in enumerate(running):
+            toks = (req.prompt + req.output)[-h:]
+            hist[i, : len(toks)] = toks
+            hlens[i] = len(toks)
+            lens[i] = req.seq_len
+            bt[i] = self._block_tables[req.row]
+            limits[i] = self._row_limits[req.row]
+            active[i] = True
+            spec_ok[i] = (req.sampling.temperature <= 0.0
+                          and req.sampling.repetition_penalty == 1.0)
+            row_idx[i] = req.row
+        pf_wave = self._fused_pf_wave
+        self._fused_pf_wave = None
+        has_prefill = pf_wave is not None
+        if has_prefill:
+            pf = (
+                jnp.asarray(pf_wave["ids"]), jnp.asarray(pf_wave["pos"]),
+                jnp.asarray(pf_wave["slots"]), jnp.asarray(pf_wave["bt"]),
+                jnp.asarray(pf_wave["cached"]),
+                jnp.asarray(pf_wave["new_lens"]),
+                jnp.asarray(pf_wave["seg"]), jnp.asarray(pf_wave["last_idx"]),
+            )
+        else:
+            pf = (None,) * 8
+
+        self._push_sampling()
+        self._rng, key = jax.random.split(self._rng)
+        row_d = jnp.asarray(row_idx)
+        # same per-burst sampler-variant rule as _decode_step: sort-free
+        # whenever no sampling row filters
+        filter_sampling = bool(
+            np.any(
+                (self._temp > 0.0)
+                & ((self._top_p < 1.0) | (self._top_k > 0))
+            )
+        )
+        self.fused_steps_total += 1
+        self.step_dispatches_total += 1
+        with annotate("engine.fused_step"):
+            out = fused_step_burst(
+                self.params, self.cfg,
+                jnp.asarray(hist), jnp.asarray(hlens), jnp.asarray(lens),
+                self._k_pages, self._v_pages,
+                jnp.asarray(bt), jnp.asarray(limits), jnp.asarray(active),
+                jnp.asarray(spec_ok), row_d, self._presence, key,
+                self._temp_d[row_d], self._top_p_d[row_d],
+                self._top_k_d[row_d], self._rep_pen_d[row_d],
+                *pf,
+                n_iters=self.spec_burst_iters, k=k, tq=self.packed_chunk,
+                use_pallas=self.use_pallas, int4_kernel=self._int4_kernel,
+                filter_sampling=filter_sampling, has_prefill=has_prefill,
+                k_scales=self._k_scales, v_scales=self._v_scales,
+            )
+        if self.kv_quant:
+            (toks_d, prop_d, pf_logits, self._k_pages, self._v_pages,
+             self._presence, self._k_scales, self._v_scales) = out
+        else:
+            (toks_d, prop_d, pf_logits, self._k_pages, self._v_pages,
+             self._presence) = out
+        if has_prefill:
+            # deferred-wave bookkeeping: presence marks, advance, first
+            # tokens (spec modes commit first tokens synchronously —
+            # _commit_first_now is True whenever spec_ngram_k > 0)
+            self._finish_packed_wave(pf_wave, pf_logits, finished, True)
+        toks = np.asarray(toks_d)  # [rb, iters, k+1], -1 padded
+        prop = np.asarray(prop_d)  # [rb, iters] — 0 on sampled rows
+        for i, req in enumerate(running):
+            for it in range(toks.shape[1]):
+                if req.state != "running":
+                    break  # device drafted past this row's stop; discard
+                self.spec_proposed += int(prop[i, it])
+                committed = 0
+                for t in toks[i, it]:
+                    if t < 0 or req.state != "running":
+                        break
+                    req.seq_len += 1
+                    self._seq_lens[req.row] = req.seq_len
+                    self._commit_token(req, int(t), finished)
+                    committed += 1
+                if committed and spec_ok[i]:
                     # committed = agreed draft prefix + 1 correction token
                     self.spec_accepted += committed - 1
 
@@ -2194,6 +2416,7 @@ class Engine:
             limits[i] = self._row_limits[req.row]
             active[i] = True
 
+        self.step_dispatches_total += 1
         with annotate("engine.draft_spec_burst"):
             out = draft_spec_burst(
                 self.params, self.draft_params, self.cfg, self.draft_cfg,
@@ -2302,6 +2525,7 @@ class Engine:
             cached[i] = req.seq_len
             new_lens[i] = n_new
 
+        self.step_dispatches_total += 1
         with annotate("engine.spec_decode"):
             # full-width logits: [rb, k+1, V] — k is small, and verification
             # needs every position
@@ -2668,6 +2892,66 @@ class Engine:
                     else:
                         (_, _, self._k_pages, self._v_pages,
                          self._dk_pages, self._dv_pages) = out
+        if self.fused_step_on:
+            # compile the whole fused-step variant set the live loop can
+            # reach: (decode row bucket) x (has_prefill) x
+            # (filter_sampling).  All-False ``active`` masks every KV
+            # write, history scatter and presence update, and the warm
+            # prefill phase's all--1 slot mapping drops its KV writes
+            # too, so each call is a pure shape-compile pass over the
+            # live pools (donated -> rebind); mixed live traffic can then
+            # never mint a new program mid-request.
+            from githubrepostorag_tpu.serving.fused_step import fused_step_burst
+
+            self._push_sampling()
+            h = self.max_seq_len
+            budget = self.prefill_token_budget
+            pfseg = self._fused_pf_segs
+            pf_warm = (
+                jnp.zeros((1, budget), jnp.int32),
+                jnp.zeros((1, budget), jnp.int32),
+                jnp.full((budget,), -1, jnp.int32),
+                jnp.zeros((pfseg, self.max_pages_per_seq), jnp.int32),
+                jnp.zeros((pfseg,), jnp.int32),
+                jnp.zeros((pfseg,), jnp.int32),
+                jnp.full((budget,), pfseg, jnp.int32),
+                jnp.zeros((pfseg,), jnp.int32),
+            )
+            for nb in buckets:
+                rows = jnp.zeros((nb,), jnp.int32)
+                for has_pf in (False, True):
+                    for filt in (False, True):
+                        self._rng, key = jax.random.split(self._rng)
+                        out = fused_step_burst(
+                            self.params, self.cfg,
+                            jnp.zeros((nb, h), jnp.int32),
+                            jnp.zeros((nb,), jnp.int32),
+                            jnp.zeros((nb,), jnp.int32),
+                            self._k_pages, self._v_pages,
+                            jnp.zeros((nb, self.max_pages_per_seq),
+                                      jnp.int32),
+                            jnp.zeros((nb,), jnp.int32),
+                            jnp.zeros((nb,), bool),
+                            jnp.zeros((nb,), bool),
+                            rows, self._presence, key,
+                            self._temp_d[rows], self._top_p_d[rows],
+                            self._top_k_d[rows], self._rep_pen_d[rows],
+                            *(pf_warm if has_pf else (None,) * 8),
+                            n_iters=self.spec_burst_iters,
+                            k=self.spec_ngram_k, tq=self.packed_chunk,
+                            use_pallas=self.use_pallas,
+                            int4_kernel=self._int4_kernel,
+                            filter_sampling=filt, has_prefill=has_pf,
+                            k_scales=self._k_scales,
+                            v_scales=self._v_scales,
+                        )
+                        if self.kv_quant:
+                            (_, _, _, self._k_pages, self._v_pages,
+                             self._presence, self._k_scales,
+                             self._v_scales) = out
+                        else:
+                            (_, _, _, self._k_pages, self._v_pages,
+                             self._presence) = out
         if self.prefix_caching:
             # the cached-prefix presence-marking program ([row bucket,
             # max_seq] — one dispatch per admission wave) only runs on
@@ -2688,7 +2972,8 @@ class Engine:
             # so each call is a pure shape compile over the live pools
             # (donated -> rebind); live migration can then never mint a
             # new program mid-traffic (CompileWatchdog-enforced in tests)
-            ps, hd = self.page_size, self.cfg.head_dim
+            # pool-stored head width (int4 pages pack head_dim // 2 bytes)
+            ps, hd = self.page_size, self._k_pages.shape[-1]
             L, n_kv = self.cfg.num_layers, self.cfg.num_kv_heads
             quant = self._k_scales is not None
             for nb in migrate_buckets(self.kv_migrate_burst):

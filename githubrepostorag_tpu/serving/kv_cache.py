@@ -51,12 +51,47 @@ class PagePools:
         return self.k.shape[3]
 
 
+def quant_bits(quant) -> int:
+    """Normalize the ``kv_quant`` knob to a bit width: 0 (off), 8 (int8
+    pages), or 4 (nibble-packed int4 pages).  Accepts the historical bool,
+    the Settings int, or the env-style string."""
+    if quant is None or quant is False:
+        return 0
+    if quant is True:
+        return 8
+    if isinstance(quant, int) and quant in (0, 4, 8):
+        return quant
+    val = str(quant).strip().lower()
+    if val in {"", "0", "false", "off"}:
+        return 0
+    if val in {"1", "true", "on", "int8", "8"}:
+        return 8
+    if val in {"int4", "4"}:
+        return 4
+    raise ValueError(f"kv_quant={quant!r} not understood; use int4, int8, or a bool")
+
+
 def make_page_pools(
     cfg: Qwen2Config, num_pages: int, page_size: int, dtype=jnp.bfloat16,
-    quant: bool = False,
+    quant=False,
 ) -> PagePools:
     shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
-    if quant:
+    bits = quant_bits(quant)
+    if bits == 4:
+        # int4: two head components share a byte (pack_int4's nibble
+        # planes), so the payload axis is hd//2 uint8 — the dtype is the
+        # discriminator every consumer keys on (uint8 pools = int4).
+        # Scales stay per-page f32 exactly like int8.
+        if cfg.head_dim % 2:
+            raise ValueError("int4 KV pages need an even head_dim")
+        packed = (*shape[:-1], cfg.head_dim // 2)
+        return PagePools(
+            k=jnp.zeros(packed, dtype=jnp.uint8),
+            v=jnp.zeros(packed, dtype=jnp.uint8),
+            ks=jnp.zeros(shape[:-2], dtype=jnp.float32),
+            vs=jnp.zeros(shape[:-2], dtype=jnp.float32),
+        )
+    if bits == 8:
         # per-PAGE scales [L, n_kv, P] (quantize_kv_paged): small enough
         # for the decode kernel's scalar-prefetch channel — per-token
         # scale tiles cost 5-18x in per-grid-step DMAs (r04)
@@ -93,6 +128,7 @@ def quantize_kv_paged(
     flat_slots: jnp.ndarray,  # [N] int32 pool slots; >= P*ps means dropped
     scales: jnp.ndarray,  # [..., P] f32 per-page scales (0 = never written)
     page_size: int,
+    qmax: int = 127,  # 127 for int8 pages, 7 for int4 nibbles
 ):
     """Per-PAGE symmetric int8 quantization for pool writes.
 
@@ -118,7 +154,7 @@ def quantize_kv_paged(
     fresh = jnp.zeros((p + 1,), bool).at[
         jnp.where(flat_slots % page_size == 0, page_of, p)
     ].set(True, mode="drop")
-    scale_new = jnp.maximum(page_amax * (KV_SCALE_HEADROOM / 127.0), 1e-8)
+    scale_new = jnp.maximum(page_amax * (KV_SCALE_HEADROOM / qmax), 1e-8)
     scales_ext = jnp.concatenate(
         [scales, jnp.ones((*lead, 1), jnp.float32)], axis=-1
     )
@@ -127,9 +163,34 @@ def quantize_kv_paged(
         upd, jnp.broadcast_to(page_of, (*lead, page_of.shape[0])), axis=-1
     )  # [..., N]
     q = jnp.clip(
-        jnp.round(vals.astype(jnp.float32) / tok_scale[..., None]), -127, 127
+        jnp.round(vals.astype(jnp.float32) / tok_scale[..., None]), -qmax, qmax
     ).astype(jnp.int8)
     return q, upd[..., :p]
+
+
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """Nibble-pack int4 values [..., hd] -> uint8 bytes [..., hd//2].
+
+    PLANE packing: byte c of a token holds component c (low nibble) and
+    component c + hd//2 (high nibble) of the SAME token, two's-complement
+    nibbles.  The split-by-half layout lets the fused kernel score each
+    plane with its own dot against the matching half of q instead of
+    interleaving lanes (ops/pallas_int4.py's idiom)."""
+    half = q.shape[-1] // 2
+    qi = q.astype(jnp.int32)
+    lo = qi[..., :half] & 0xF
+    hi = (qi[..., half:] & 0xF) << 4
+    return (lo | hi).astype(jnp.uint8)
+
+
+def unpack_int4(b: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of pack_int4: uint8 [..., hd//2] -> int8 values [..., hd].
+    Sign extension is ``((x & 0xF) ^ 8) - 8`` per nibble (two's
+    complement), the exact formula the fused kernel applies in-register."""
+    bi = b.astype(jnp.int32)
+    lo = ((bi & 0xF) ^ 8) - 8
+    hi = ((bi >> 4) ^ 8) - 8
+    return jnp.concatenate([lo, hi], axis=-1).astype(jnp.int8)
 
 
 def commit_paged(
@@ -144,11 +205,16 @@ def commit_paged(
     decode-burst (serving/decode_burst), and ring-prefill
     (serving/long_prefill) paths so the quantization/scatter semantics can
     never drift apart.  ``scales is None`` = full-precision pools (vals
-    cast to the pool dtype); else int8 pools with each page's scale fixed
-    by its first write (quantize_kv_paged).  Returns (pools, scales)."""
-    p, ps, hd = pools.shape[-3:]
+    cast to the pool dtype); else quantized pools with each page's scale
+    fixed by its first write (quantize_kv_paged) — int8 when the pool
+    dtype is int8, nibble-packed int4 (pack_int4) when it is uint8.
+    Returns (pools, scales)."""
+    p, ps, hd = pools.shape[-3:]  # hd is the STORED payload width
     if scales is None:
         vals = vals.astype(pools.dtype)
+    elif pools.dtype == jnp.uint8:
+        vals, scales = quantize_kv_paged(vals, flat_slots, scales, page_size, qmax=7)
+        vals = pack_int4(vals)  # [..., N, hd] -> [..., N, hd//2] == pool hd
     else:
         vals, scales = quantize_kv_paged(vals, flat_slots, scales, page_size)
     flat = pools.reshape(-1, p * ps, hd)

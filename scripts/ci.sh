@@ -88,6 +88,17 @@ echo "== segment-packed ring prefill A/B (CPU-tiny) =="
 # obs budget.
 BENCH_ONLY=longctx JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
 
+echo "== fused-step A/B (CPU-tiny) =="
+# one fused launch per engine step (packed prefill + spec-verify + paged
+# attention + sampling) vs the unfused per-iteration spec path on the
+# same 64-request mixed spec/plain wave over identical engines at equal
+# HBM, plus an int4-KV fused arm: bench_fused_pair asserts fused goodput
+# >= 1.3x unfused, greedy rows token-identical across all three arms,
+# int4 pages >= 1.8x int8 at equal pool bytes, zero live-traffic XLA
+# compiles, and SLO overhead (incl. the dispatch-attribution counters)
+# inside the 2% obs budget.
+BENCH_ONLY=fused JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -196,6 +196,18 @@ def build_table(records: list[dict], driver_name: str,
           "longctx_conc8_cpu_agg_prefill_tok_s_packed"], "tok/s"),
         ("Longctx conc8 packed-ring speedup at equal sp=2 (CPU A/B)",
          ["longctx_conc8_cpu_packed_speedup"], "×"),
+        ("Fused-step conc64 goodput, unfused / fused / fused-int4 (CPU A/B)",
+         ["fused_conc64_cpu_goodput_tok_s_unfused",
+          "fused_conc64_cpu_goodput_tok_s_fused",
+          "fused_conc64_cpu_goodput_tok_s_fused_int4"], "tok/s"),
+        ("Fused-step goodput speedup / spec acceptance (CPU A/B)",
+         ["fused_conc64_cpu_fused_goodput_speedup",
+          "fused_conc64_cpu_spec_acceptance"], ""),
+        ("Fused-step TTFT p95, unfused / fused (CPU A/B)",
+         ["fused_conc64_cpu_ttft_p95_ms_unfused",
+          "fused_conc64_cpu_ttft_p95_ms_fused"], "ms"),
+        ("int4 KV pages admitted vs int8 at equal pool bytes (CPU A/B)",
+         ["fused_conc64_cpu_int4_page_ratio"], "×"),
         ("Qwen2-MoE 16-expert decode, bs=8 (beyond-reference)",
          ["decode_tok_s_per_chip_qwen2-moe-16e_bs8"], "tok/s"),
         ("Qwen2-MoE 16-expert INT8 decode, bs=8",
@@ -222,7 +234,7 @@ def render(root: pathlib.Path = ROOT, driver_name: str | None = None) -> str:
     # summary records so the committed A/B wins any same-name collision
     for artifact in ("BENCH_retrieval_cpu.json", "BENCH_spec_cpu.json",
                      "BENCH_kv_tier_cpu.json", "BENCH_disagg_cpu.json",
-                     "BENCH_longctx_cpu.json"):
+                     "BENCH_longctx_cpu.json", "BENCH_fused_cpu.json"):
         path = root / artifact
         if path.exists():
             records += json.loads(path.read_text())["records"]

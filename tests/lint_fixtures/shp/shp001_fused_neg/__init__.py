@@ -1,0 +1,3 @@
+"""SHP001 negative (fused-decode flavor): the same draft flow, but the
+verify window is padded to the static k+1 the engine compiled — one
+fused program per (row bucket, k), any draft length."""

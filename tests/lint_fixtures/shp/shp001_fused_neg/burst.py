@@ -1,0 +1,6 @@
+from shp001_fused_neg.grid import window_grid
+
+
+def fused_burst(rows, draft_tokens):
+    width = len(draft_tokens) + 1
+    return window_grid(rows, width)

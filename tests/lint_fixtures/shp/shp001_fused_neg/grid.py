@@ -1,0 +1,8 @@
+import jax.numpy as jnp
+
+SPEC_K = 4
+
+
+def window_grid(rows, width):
+    width = SPEC_K + 1  # static window: short drafts pad, never resize
+    return jnp.zeros((rows, width), jnp.int32)

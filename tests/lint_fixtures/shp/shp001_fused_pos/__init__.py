@@ -1,0 +1,5 @@
+"""SHP001 positive (fused-decode flavor): the spec-verify window width of
+the fused step grid is len() of the live n-gram draft; sizing the
+[rows, width] window buffer by it compiles a fresh fused program for
+every draft length traffic produces.  The source is in burst.py, the
+sink in grid.py."""

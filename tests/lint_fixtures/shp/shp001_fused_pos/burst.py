@@ -1,0 +1,8 @@
+from shp001_fused_pos.grid import window_grid
+
+
+def fused_burst(rows, draft_tokens):
+    # len() of the n-gram draft is the taint source: it varies with every
+    # history match, so the fused window shape follows live traffic
+    width = len(draft_tokens) + 1
+    return window_grid(rows, width)

@@ -1,0 +1,2 @@
+"""SHP001 suppressed (fused-decode flavor): the positive flow with a
+justified inline suppression on the sink line."""

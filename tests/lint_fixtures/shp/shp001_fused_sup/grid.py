@@ -1,0 +1,5 @@
+import jax.numpy as jnp
+
+
+def window_grid(rows, width):
+    return jnp.zeros((rows, width), jnp.int32)  # tpulint: disable=SHP001 -- kernel parity harness replays one captured draft, single compile

@@ -1,0 +1,3 @@
+"""SHP002 negative (fused-decode flavor): the same serving class, but
+warmup() precompiles the jitted fused step at every row bucket the hot
+path can dispatch."""

@@ -1,0 +1,25 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def fused_step_burst(hist):
+    return hist + 1
+
+
+def row_bucket(n, cap, minimum=1):
+    b = minimum
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class FusedStepEngine:
+    def warmup(self):
+        for rb in (1, 2, 4, 8):
+            fused_step_burst(jnp.zeros((rb, 64), jnp.int32))
+
+    def decode_step(self, running):
+        rb = row_bucket(len(running), 8)
+        hist = jnp.zeros((rb, 64), jnp.int32)
+        return fused_step_burst(hist)

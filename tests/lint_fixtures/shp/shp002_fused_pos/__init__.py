@@ -1,0 +1,4 @@
+"""SHP002 positive (fused-decode flavor): a serving class dispatches its
+jitted fused step at row-bucketed shapes on the hot path but defines no
+warmup routine — the (bucket, has_prefill, filter) variant set compiles
+under live traffic."""

@@ -1,0 +1,2 @@
+"""SHP002 suppressed (fused-decode flavor): no-warmup fused-step class
+with a justified inline suppression on the class line."""

@@ -1,0 +1,21 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def fused_step_burst(hist):
+    return hist + 1
+
+
+def row_bucket(n, cap, minimum=1):
+    b = minimum
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class FusedStepEngine:  # tpulint: disable=SHP002 -- the serving launcher drives the fused variant ladder before opening traffic
+    def decode_step(self, running):
+        rb = row_bucket(len(running), 8)
+        hist = jnp.zeros((rb, 64), jnp.int32)
+        return fused_step_burst(hist)

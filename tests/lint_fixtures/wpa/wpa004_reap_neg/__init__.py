@@ -1,0 +1,3 @@
+"""WPA004 reap negative (int4 flavor): the correct reap sweep — int4
+nibble planes share one page handle, released exactly once, with the
+per-page scale table cleared alongside."""

@@ -1,0 +1,13 @@
+from wpa004_reap_neg.pool import PagePool
+
+
+class Reaper:
+    def __init__(self):
+        self.pool = PagePool()
+        self.scales = {}
+
+    def reap_int4_request(self, n):
+        pages = self.pool.allocate(n)
+        # one handle covers both nibble planes: exactly one release
+        self.scales.pop(id(pages), None)
+        self.pool.release(pages)

@@ -1,0 +1,5 @@
+"""WPA004 reap positive (int4 flavor): the reap sweep frees an int4
+request's pages once per nibble plane — the k-plane and v-plane views
+share ONE page handle, so the second release is a double-free — and a
+deadline reap that drops the handle after clearing the scale table
+without ever releasing (the int4 reap leak)."""

@@ -1,0 +1,19 @@
+from wpa004_reap_pos.pool import PagePool
+
+
+class Reaper:
+    def __init__(self):
+        self.pool = PagePool()
+        self.scales = {}
+
+    def reap_int4_request(self, n):
+        pages = self.pool.allocate(n)
+        # int4 pools store k and v as nibble planes of the SAME pages:
+        # sweeping "per plane" returns the one handle twice
+        self.pool.release(pages)  # k-plane sweep
+        self.pool.release(pages)  # v-plane sweep: double-free
+
+    def reap_on_deadline(self, rid, n):
+        pages = self.pool.allocate(n)
+        self.scales.pop(rid, None)  # per-page scale table cleared...
+        return None  # ...but the pages never release: reap leak

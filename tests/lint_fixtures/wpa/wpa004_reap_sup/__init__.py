@@ -1,0 +1,2 @@
+"""WPA004 reap suppressed (int4 flavor): the double-free shape silenced
+with a justified directive at the second release."""

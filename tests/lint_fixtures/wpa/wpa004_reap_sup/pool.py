@@ -1,0 +1,17 @@
+class OutOfPages(Exception):
+    pass
+
+
+class PagePool:
+    def __init__(self, n=8):
+        self.free = list(range(n))
+
+    def allocate(self, n):
+        if n > len(self.free):
+            raise OutOfPages()
+        out, rest = self.free[:n], self.free[n:]
+        self.free = rest
+        return out
+
+    def release(self, pages):
+        self.free.extend(pages)

@@ -1,0 +1,12 @@
+from wpa004_reap_sup.pool import PagePool
+
+
+class Reaper:
+    def __init__(self):
+        self.pool = PagePool()
+
+    def reap_int4_request(self, n):
+        pages = self.pool.allocate(n)
+        self.pool.release(pages)
+        # tpulint: disable=WPA004 -- idempotent shutdown sweep: release() tolerates already-freed pages during teardown only
+        self.pool.release(pages)

@@ -253,6 +253,57 @@ def test_shp002_ring_suppressed_is_silenced_with_justification():
     assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
 
 
+# The fused decode step extends both SHP alphabets once more: the
+# spec-verify window of the fused kernel grid must be the STATIC k+1 the
+# engine compiled (short drafts pad — ops/fused_decode.py scores a fixed
+# [rows, S] window per bucket), never the live draft length, and a class
+# dispatching the fused burst at row buckets must precompile the whole
+# (bucket, has_prefill, filter) variant set in warmup — exactly what
+# serving/engine.py's fused warmup ladder exists for.
+
+def test_shp001_fused_positive_catches_draft_sized_window():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_fused_pos"])
+    hits = [f for f in findings if f.rule == "SHP001" and not f.suppressed]
+    assert hits, "draft-length-sized fused window escaped the taint pass"
+    (hit,) = hits
+    assert "len(draft_tokens)" in hit.taint_chain[0]
+    assert "burst.py" in hit.taint_chain[0]  # source module
+    assert "grid.py" in hit.taint_chain[-1]  # sink module
+
+
+def test_shp001_fused_negative_is_silent():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_fused_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_shp001_fused_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([SHP_FIXTURES / "shp001_fused_sup"])
+    hits = [f for f in findings if f.rule == "SHP001"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
+def test_shp002_fused_positive_flags_unwarmed_fused_ladder():
+    findings, _ = run_paths([SHP_FIXTURES / "shp002_fused_pos"])
+    hits = [f for f in findings if f.rule == "SHP002" and not f.suppressed]
+    assert any("FusedStepEngine" in f.message for f in hits), (
+        "fused-step class with no warmup escaped SHP002")
+
+
+def test_shp002_fused_negative_is_silent():
+    findings, _ = run_paths([SHP_FIXTURES / "shp002_fused_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_shp002_fused_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([SHP_FIXTURES / "shp002_fused_sup"])
+    hits = [f for f in findings if f.rule == "SHP002"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
 # The SPD (spmdflow) fixtures follow the same convention: each rule has a
 # pos/neg/sup mini-package.  The SPD001 positive splits the mesh
 # construction and the bad collective across modules; the SPD002 positive
@@ -506,6 +557,32 @@ def test_wpa004_park_negative_is_silent():
 
 def test_wpa004_park_suppressed_is_silenced_with_justification():
     findings, _ = run_paths([WPA_FIXTURES / "wpa004_park_sup"])
+    hits = [f for f in findings if f.rule == "WPA004"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+    assert RULE_STALE_SUPPRESSION not in {f.rule for f in findings}
+
+
+# int4 KV pages sharpen the WPA004 reap path: both nibble planes of an
+# int4 pool live in ONE set of page handles (serving/kv_cache.py packs
+# k's halves into the same uint8 page), so a reap sweep that frees "per
+# plane" double-frees, and clearing the per-page scale table without
+# releasing the pages strands them forever.
+
+def test_wpa004_reap_positive_catches_per_plane_double_free_and_leak():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_reap_pos"])
+    messages = [f.message for f in findings if f.rule == "WPA004"]
+    assert any("double-free" in m for m in messages), messages
+    assert any("leak" in m for m in messages), messages
+
+
+def test_wpa004_reap_negative_is_silent():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_reap_neg"])
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_wpa004_reap_suppressed_is_silenced_with_justification():
+    findings, _ = run_paths([WPA_FIXTURES / "wpa004_reap_sup"])
     hits = [f for f in findings if f.rule == "WPA004"]
     assert hits, "suppressed variant should still produce (suppressed) findings"
     assert all(f.suppressed and f.justification for f in hits)
