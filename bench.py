@@ -1826,6 +1826,194 @@ def bench_routing_pair(tag: str, *, waves: int = 4, per_wave: int = 64,
             for pol, r in out.items()} | {"speedup": speedup, "hits": hits}
 
 
+def bench_controller_pair(tag: str, *, pre: int = 64, post: int = 64,
+                          gen_tokens: int = 8, clients: int = 8,
+                          req_timeout_s: float = 2.0) -> dict:
+    """``controller_conc128``: self-healing fleet controller A/B — the
+    SAME mid-run replica kill (FAULTS ``fleet.step.r0:error`` fired on the
+    driver seam) against IDENTICAL 2-active + 1-warm-spare fleets, with
+    the reconciliation loop ON vs OFF.  128 requests per arm: a 64-request
+    pre-kill pass establishes baseline goodput, r0's driver is killed,
+    and a 64-request recovery pass measures goodput with the corpse in
+    the fleet.  Closed-loop client pool; every request is bounded by a
+    per-request timeout so a hung corpse shows up as LOST requests and
+    cratered goodput, never as a hung bench.
+
+    With the controller on, the liveness probe sees the dead driver
+    thread, fences the victim (in-flight work fails with error frames —
+    fast, bounded), activates the warm spare, and retires the corpse:
+    recovery goodput stays >= 0.8x pre-kill (the gate).  With it off,
+    the router keeps offering work to the corpse and every such request
+    burns its full timeout: recovery goodput collapses below the same
+    bar — the A/B is the controller's reason to exist."""
+    import asyncio
+
+    from githubrepostorag_tpu.config import reload_settings
+    from githubrepostorag_tpu.models.qwen2 import Qwen2Config, init_params
+    from githubrepostorag_tpu.obs.slo import reset_slo_plane
+    from githubrepostorag_tpu.resilience.faults import reset_faults
+    from githubrepostorag_tpu.resilience.policy import reset_breakers
+    from githubrepostorag_tpu.serving.controller import FleetController
+    from githubrepostorag_tpu.serving.engine import Engine
+    from githubrepostorag_tpu.serving.multi_engine import MultiAsyncEngine
+    from githubrepostorag_tpu.serving.sampling_params import SamplingParams
+
+    cfg = Qwen2Config.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(17), dtype=jnp.float32)
+    geom = dict(max_num_seqs=4, num_pages=32, page_size=8, max_seq_len=64,
+                prefill_chunk=32, kv_dtype=jnp.float32, decode_burst=4)
+    rng = np.random.default_rng(41)
+    pre_prompts = [rng.integers(0, cfg.vocab_size, 12).tolist()
+                   for _ in range(pre)]
+    post_prompts = [rng.integers(0, cfg.vocab_size, 12).tolist()
+                    for _ in range(post)]
+    sp = SamplingParams(max_tokens=gen_tokens, temperature=0.0,
+                        stop_token_ids=())
+
+    # fast reconcile cadence for a seconds-long bench; liveness timeout
+    # ABOVE any CPU compile stall so the only failover trigger is the
+    # genuinely dead driver thread
+    ctrl_env = {"CTRL_TICK_S": "0.05", "CTRL_HYSTERESIS_TICKS": "2",
+                "CTRL_COOLDOWN_S": "1", "CTRL_LIVENESS_TIMEOUT_S": "30",
+                "CTRL_MAX_ACTIONS": "4", "CTRL_ACTION_WINDOW_S": "60"}
+    saved = {k: os.environ.get(k) for k in [*ctrl_env, "FAULTS"]}
+
+    async def phase(multi, batch) -> dict:
+        results: list = [None] * len(batch)
+        todo = iter(range(len(batch)))
+
+        async def client() -> None:
+            for i in todo:
+                try:
+                    results[i] = await asyncio.wait_for(
+                        multi.generate(batch[i], sp), timeout=req_timeout_s)
+                except asyncio.TimeoutError:
+                    results[i] = "timeout"
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(client() for _ in range(clients)))
+        wall = time.monotonic() - t0
+        ok = [r for r in results
+              if r not in (None, "timeout") and r.finish_reason in
+              ("length", "stop")]
+        return {
+            "wall_s": wall,
+            "goodput_tok_s": sum(len(r.output_tokens) for r in ok) / wall,
+            "ok": len(ok),
+            "errors": sum(1 for r in results if r not in (None, "timeout")
+                          and r.finish_reason == "error"),
+            "timeouts": results.count("timeout"),
+        }
+
+    async def run(arm: str) -> dict:
+        # per-arm singletons: breaker history and plane registrations from
+        # the previous arm must not leak into this one
+        reset_breakers()
+        reset_slo_plane()
+        engines = [Engine(params, cfg, **geom) for _ in range(3)]
+        for eng in engines:  # the spare warms too: activation is compile-free
+            eng.warmup()
+        multi = MultiAsyncEngine(engines, policy="least_loaded", spares=1)
+        ctrl = None
+        out: dict = {"arm": arm}
+        try:
+            await multi.start()
+            if arm == "on":
+                ctrl = FleetController(multi)
+                await ctrl.start()
+            out["pre"] = await phase(multi, pre_prompts)
+            # kill r0: its driver seam errors on the next iteration and the
+            # thread exits — a dead replica mid-fleet, load still arriving
+            os.environ["FAULTS"] = "fleet.step.r0:error"
+            reload_settings()
+            reset_faults()
+            for _ in range(500):
+                if not multi._by_id["r0"].driver_alive():
+                    break
+                await asyncio.sleep(0.01)
+            assert not multi._by_id["r0"].driver_alive(), \
+                "FAULTS never killed r0's driver"
+            out["post"] = await phase(multi, post_prompts)
+            out["recovery_ratio"] = (out["post"]["goodput_tok_s"]
+                                     / max(out["pre"]["goodput_tok_s"], 1e-9))
+            if ctrl is not None:
+                out["controller"] = ctrl.payload()
+            out["per_replica"] = {
+                r: {"lifecycle": v["lifecycle"], "routed": v["routed"]}
+                for r, v in multi.router_stats()["per_replica"].items()}
+        finally:
+            os.environ.pop("FAULTS", None)
+            reload_settings()
+            reset_faults()
+            if ctrl is not None:
+                ctrl.stop()
+            await multi.stop()
+        return out
+
+    out: dict[str, dict] = {}
+    try:
+        for key, value in ctrl_env.items():
+            os.environ[key] = value
+        reload_settings()
+        for arm in ("off", "on"):
+            out[arm] = asyncio.run(run(arm))
+    finally:
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        reload_settings()
+        reset_faults()
+
+    for arm in ("off", "on"):
+        r = out[arm]
+        emit(f"{tag}_goodput_pre_tok_s_{arm}", r["pre"]["goodput_tok_s"],
+             "tok/s", None, wall_s=round(r["pre"]["wall_s"], 3))
+        emit(f"{tag}_goodput_post_tok_s_{arm}", r["post"]["goodput_tok_s"],
+             "tok/s", None, wall_s=round(r["post"]["wall_s"], 3),
+             errors=r["post"]["errors"], timeouts=r["post"]["timeouts"])
+        emit(f"{tag}_recovery_ratio_{arm}", r["recovery_ratio"], "ratio", None)
+        log(f"bench[{tag}]: {arm} pre {r['pre']['goodput_tok_s']:.0f} tok/s "
+            f"-> post {r['post']['goodput_tok_s']:.0f} tok/s "
+            f"({r['recovery_ratio']:.2f}x), {r['post']['ok']} ok / "
+            f"{r['post']['errors']} error-framed / "
+            f"{r['post']['timeouts']} timed out")
+
+    on, off = out["on"], out["off"]
+    # the gates: the controller arm recovers, the off arm does not
+    assert on["recovery_ratio"] >= 0.8, \
+        (f"controller arm recovered only {on['recovery_ratio']:.2f}x "
+         f"pre-kill goodput (gate 0.8x)")
+    assert off["recovery_ratio"] < 0.8, \
+        (f"no-controller arm recovered {off['recovery_ratio']:.2f}x — the "
+         f"kill did not bite, the A/B proves nothing")
+    assert on["post"]["timeouts"] == 0, \
+        (f"{on['post']['timeouts']} request(s) HUNG to timeout with the "
+         f"controller on — fence must fail in-flight work, fast")
+    assert off["post"]["timeouts"] > 0, \
+        "off arm never hung a request against the corpse"
+    assert on["per_replica"]["r2"]["lifecycle"] == "active", \
+        "controller never activated the warm spare"
+    assert on["per_replica"]["r0"]["lifecycle"] == "drained", \
+        "controller never retired the corpse"
+    fo = [e for e in on["controller"]["log"]
+          if e["action"] == "failover" and e["status"] == "dispatched"
+          and e["replica"] == "r0"]
+    assert fo and fo[0]["justification"]["liveness"]["thread_alive"] is False, \
+        "failover action missing its liveness justification stamp"
+    speedup = on["recovery_ratio"] / max(off["recovery_ratio"], 1e-9)
+    emit(f"{tag}_recovery_vs_off", speedup, "x", None)
+    log(f"bench[{tag}]: controller recovery {on['recovery_ratio']:.2f}x vs "
+        f"{off['recovery_ratio']:.2f}x without ({speedup:.1f}x), spare "
+        f"activated, corpse retired, 0 hung requests on the controller arm")
+    return {"on": {k: out["on"][k] for k in ("pre", "post", "recovery_ratio")},
+            "off": {k: out["off"][k] for k in ("pre", "post",
+                                               "recovery_ratio")},
+            "speedup": speedup,
+            "failover_reason": fo[0]["reason"]}
+
+
 def bench_disagg_pair(tag: str, *, waves: int = 4, per_wave: int = 64,
                       prefix_len: int = 48, tail_len: int = 17,
                       prompt_len: int = 129, gen_tokens: int = 16,
@@ -2521,6 +2709,48 @@ def _run_fused_cpu(artifact_dir: str) -> None:
         log(f"bench: could not write BENCH_fused_cpu.json ({exc})")
 
 
+def _run_controller_cpu(artifact_dir: str) -> None:
+    """Run the self-healing fleet-controller A/B and write its
+    committed-artifact JSON.  Same convention as the other artifacts: the
+    full CPU run writes next to bench.py, BENCH_ONLY=controller CI reruns
+    write under artifacts/."""
+    if not budget_allows("controller_conc128_cpu", 120):
+        return
+    before = len(_RECORDS)
+    ct = bench_controller_pair("controller_conc128_cpu")
+    recs = _RECORDS[before:]
+    try:
+        os.makedirs(artifact_dir, exist_ok=True)
+        with open(os.path.join(artifact_dir,
+                               "BENCH_controller_cpu.json"), "w") as f:
+            json.dump({
+                "scenario": ("controller_conc128 (CPU A/B; self-healing "
+                             "fleet controller vs no controller under a "
+                             "mid-run replica kill)"),
+                "platform": "cpu",
+                "note": (
+                    "128 requests per arm over identical 2-active + "
+                    "1-warm-spare fleets, closed-loop 8-client pool, "
+                    "per-request timeout bounds every await; r0's driver "
+                    "is FAULTS-killed between the 64-request pre and post "
+                    "passes. Controller arm recovers "
+                    f"{ct['on']['recovery_ratio']:.2f}x pre-kill goodput "
+                    "(gate 0.8x) via "
+                    f"fence -> spare activation ({ct['failover_reason']}-"
+                    "triggered failover) with 0 hung requests; without it "
+                    f"recovery collapses to "
+                    f"{ct['off']['recovery_ratio']:.2f}x with "
+                    f"{ct['off']['post']['timeouts']} requests hung to "
+                    "timeout against the corpse "
+                    f"({ct['speedup']:.1f}x recovery delta)."),
+                "records": recs,
+                "summary": {r["metric"]: r["value"] for r in recs},
+            }, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        log(f"bench: could not write BENCH_controller_cpu.json ({exc})")
+
+
 def _main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -2537,7 +2767,8 @@ def _main() -> None:
                    "liveindex": _run_liveindex_cpu,
                    "preempt": _run_preempt_cpu,
                    "longctx": _run_longctx_cpu,
-                   "fused": _run_fused_cpu}
+                   "fused": _run_fused_cpu,
+                   "controller": _run_controller_cpu}
         if only not in runners:
             log(f"bench: unknown BENCH_ONLY={only!r} "
                 f"(supported: {', '.join(sorted(runners))})")
@@ -2622,6 +2853,7 @@ def _main() -> None:
         _run_preempt_cpu(os.path.dirname(__file__) or ".")
         _run_longctx_cpu(os.path.dirname(__file__) or ".")
         _run_fused_cpu(os.path.dirname(__file__) or ".")
+        _run_controller_cpu(os.path.dirname(__file__) or ".")
         return
 
     # ---- headline: eval config #1 geometry (0.5B, bs=8) -----------------

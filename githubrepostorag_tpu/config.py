@@ -274,6 +274,47 @@ class Settings:
     # shortest matchable prefix run (in pages) that counts as an affinity hit
     route_min_prefix_pages: int = field(
         default_factory=lambda: _env_int("ROUTE_MIN_PREFIX_PAGES", 1))
+    # how many dp replicas start as warm spares (admit nothing until
+    # activated — the controller's failover target); clamped so at least
+    # one replica stays active
+    fleet_spares: int = field(
+        default_factory=lambda: _env_int("FLEET_SPARES", 0))
+
+    # --- Self-healing fleet controller (serving/controller.py) ---
+    # "on" starts the reconcile loop beside the serving pod; "off"
+    # (default) leaves every actuator manual (POST /debug/fleet/*)
+    ctrl: str = field(default_factory=lambda: os.getenv("CTRL", "off"))
+    # reconcile cadence: sense -> decide -> act once per tick
+    ctrl_tick_s: float = field(
+        default_factory=lambda: _env_float("CTRL_TICK_S", 1.0))
+    # consecutive agreeing ticks before a decision becomes an action
+    ctrl_hysteresis_ticks: int = field(
+        default_factory=lambda: _env_int("CTRL_HYSTERESIS_TICKS", 2))
+    # per (replica, action) quiet period after an action executes
+    ctrl_cooldown_s: float = field(
+        default_factory=lambda: _env_float("CTRL_COOLDOWN_S", 30.0))
+    # runaway-remediation budget: at most N actions per sliding window
+    ctrl_max_actions: int = field(
+        default_factory=lambda: _env_int("CTRL_MAX_ACTIONS", 4))
+    ctrl_action_window_s: float = field(
+        default_factory=lambda: _env_float("CTRL_ACTION_WINDOW_S", 300.0))
+    # driver-step heartbeat older than this marks a replica wedged
+    ctrl_liveness_timeout_s: float = field(
+        default_factory=lambda: _env_float("CTRL_LIVENESS_TIMEOUT_S", 5.0))
+    # hbm_pages remediation: host-pool growth factor and hard cap
+    # (0 = 8x the device pool, matching the allocator's own scale)
+    ctrl_host_pool_grow: float = field(
+        default_factory=lambda: _env_float("CTRL_HOST_POOL_GROW", 1.5))
+    ctrl_host_pool_max_pages: int = field(
+        default_factory=lambda: _env_int("CTRL_HOST_POOL_MAX_PAGES", 0))
+    # per-replica stat-collection deadline: a wedged driver lock yields a
+    # stale_since row instead of hanging /debug/fleet
+    ctrl_stats_timeout_s: float = field(
+        default_factory=lambda: _env_float("CTRL_STATS_TIMEOUT_S", 0.25))
+    # where the controller looks for the latest index snapshot when it
+    # activates a warm spare ("" = activate cold, no restore)
+    ctrl_snapshot_dir: str = field(
+        default_factory=lambda: os.getenv("CTRL_SNAPSHOT_DIR", ""))
 
     # --- Disaggregated prefill/decode serving (serving/disagg.py) ---
     # "on" splits a >=2-replica tiered fleet into prefill-specialized and

@@ -352,6 +352,30 @@ FLEET_LIFECYCLE = Gauge(
     ["replica"],
     registry=REGISTRY,
 )
+# --- Self-healing fleet controller (serving/controller.py)
+CTRL_ACTIONS = Counter(
+    "rag_ctrl_actions_total",
+    "Fleet-controller remediation actions executed, by action ladder rung "
+    "(failover / grow_host_pool / spec_k_down / spread_affinity) and the "
+    "sensed reason that justified it",
+    ["action", "reason"],
+    registry=REGISTRY,
+)
+CTRL_FAILOPEN = Counter(
+    "rag_ctrl_failopen_total",
+    "Controller-internal exceptions survived by failing open (the tick or "
+    "action was abandoned, the fleet kept serving; a rising rate means the "
+    "controller is observe-only in practice)",
+    registry=REGISTRY,
+)
+CTRL_SUPPRESSED = Counter(
+    "rag_ctrl_suppressed_total",
+    "Controller decisions withheld by a guard: hysteresis (ticks not yet "
+    "agreeing), cooldown, action-window budget, or an in-flight action on "
+    "the same replica",
+    ["guard"],
+    registry=REGISTRY,
+)
 # --- Disaggregated prefill/decode serving (serving/disagg.py)
 FLEET_ROLE = Gauge(
     "rag_fleet_replica_role",

@@ -272,6 +272,26 @@ class TokenLedger:
         with self._lock:
             return self._limiter_locked(now)
 
+    def justification(self, now: float | None = None) -> dict:
+        """Compact window view the fleet controller stamps onto every
+        action it takes (the ledger evidence that justified remediation).
+        Unlike ``snapshot`` this never publishes to the registry — the
+        controller reads it every tick for every replica."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune(now)
+            elapsed = self._elapsed(now)
+            s = self._sums
+            return {
+                "window_s": self.window_s,
+                "elapsed_s": round(elapsed, 6),
+                "steps": int(s.get("steps", 0.0)),
+                "goodput_tok_s": round(
+                    s.get("committed", 0.0) / elapsed if elapsed else 0.0, 3),
+                "committed_tokens": int(s.get("committed", 0.0)),
+                "limiter": self._limiter_locked(now),
+            }
+
     def snapshot(self, now: float | None = None) -> dict:
         """Rolling-window view for /debug/slo + /debug/fleet payloads."""
         now = time.monotonic() if now is None else now

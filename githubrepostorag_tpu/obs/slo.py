@@ -185,6 +185,22 @@ class SLOMonitor:
                 out[klass] = max(out.get(klass, OK), st)
             return out
 
+    def burn_state(self, now: float | None = None) -> dict:
+        """Compact burn view for the fleet controller's decision snapshot:
+        worst state overall plus the worst state per class, refreshed at
+        the caller's (possibly simulated) clock."""
+        now = time.monotonic() if now is None else now
+        self.maybe_refresh(now, force=True)
+        with self._lock:
+            worst = max(self._state.values(), default=OK)
+            classes: dict[str, int] = {}
+            for (_name, klass), st in self._state.items():
+                classes[klass] = max(classes.get(klass, OK), st)
+        return {
+            "state": STATE_NAMES[worst],
+            "classes": {k: STATE_NAMES[v] for k, v in sorted(classes.items())},
+        }
+
     def transition_counts(self) -> dict[tuple[str, str, str], int]:
         with self._lock:
             return dict(self._transitions)
@@ -228,6 +244,7 @@ class SLOPlane:
         self._lock = threading.Lock()
         self._replicas: dict[str, dict] = {}
         self._router_info = None
+        self._controller_info = None
 
     def register(self, replica: str, *, ledger=None, monitor=None,
                  stats=None, digest=None) -> None:
@@ -244,9 +261,35 @@ class SLOPlane:
         with self._lock:
             self._router_info = provider
 
+    def set_controller_info(self, provider) -> None:
+        """Fleet controller registers a zero-arg callable returning its
+        action log / cooldown / hysteresis view for the fleet payload
+        (same inversion as the router info)."""
+        with self._lock:
+            self._controller_info = provider
+
     def unregister(self, replica: str) -> None:
         with self._lock:
             self._replicas.pop(replica, None)
+
+    def decision_snapshot(self, now: float | None = None) -> dict[str, dict]:
+        """Controller-consumable sense snapshot: per replica, the ledger's
+        window justification and the monitor's burn state, all evaluated
+        at ONE caller-supplied clock reading so a simulated-clock test is
+        deterministic.  Never touches the prometheus registry beyond the
+        monitor's gauge refresh."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entries = sorted(self._replicas.items())
+        out: dict[str, dict] = {}
+        for rid, e in entries:
+            led = e.get("ledger")
+            mon = e.get("monitor")
+            out[rid] = {
+                "ledger": led.justification(now) if led is not None else None,
+                "burn": mon.burn_state(now) if mon is not None else None,
+            }
+        return out
 
     def admission_hint(self) -> str:
         with self._lock:
@@ -337,6 +380,7 @@ class SLOPlane:
         with self._lock:
             entries = sorted(self._replicas.items())
             router_info = self._router_info
+            controller_info = self._controller_info
         replicas = []
         goodput = 0.0
         committed = 0
@@ -374,6 +418,12 @@ class SLOPlane:
                 router = router_info() or None
             except Exception:  # noqa: BLE001 - debug payload must render
                 router = None
+        controller = None
+        if callable(controller_info):
+            try:
+                controller = controller_info() or None
+            except Exception:  # noqa: BLE001 - debug payload must render
+                controller = None
         roles: dict[str, int] = {}
         for r in replicas:
             roles[r["role"]] = roles.get(r["role"], 0) + 1
@@ -387,6 +437,7 @@ class SLOPlane:
                 "wasted_tokens": wasted,
             },
             "router": router,
+            "controller": controller,
             "replicas": replicas,
         }
 

@@ -16,7 +16,10 @@ Grammar per ``;``-separated entry: ``site:action[@param]`` where
   - ``@param`` selects WHICH calls fire: an integer N >= 1 means
     deterministically every Nth call at that site (``drop@3`` = calls
     3, 6, 9, ...); a float in (0, 1) is a seeded per-call probability
-    (``error@0.5``); omitted means every call.
+    (``error@0.5``); ``window=N:M`` fires only on calls N..M inclusive,
+    1-based (``delay=2@window=5:8`` = calls 5, 6, 7, 8; ``error@window=40:``
+    is open-ended from call 40) so a chaos script can express "healthy,
+    then dies, then recovers" at one site; omitted means every call.
 
 Probabilities draw from ``random.Random(FAULTS_SEED ^ crc32(site))`` — the
 builtin ``hash()`` is salted per process and would unseed the chaos suite.
@@ -55,6 +58,8 @@ class _Fault:
     action: str  # "drop" | "error" | "delay"
     every: int | None = None  # fire every Nth call
     probability: float | None = None  # seeded per-call probability
+    window_lo: int | None = None  # fire only on calls N..M (1-based, inclusive)
+    window_hi: int | None = None  # None = open-ended
     delay_s: float = 0.0
     calls: int = 0
     fired: int = 0
@@ -62,6 +67,10 @@ class _Fault:
 
     def should_fire(self) -> bool:
         self.calls += 1
+        if self.window_lo is not None:
+            if self.calls < self.window_lo:
+                return False
+            return self.window_hi is None or self.calls <= self.window_hi
         if self.every is not None:
             return self.calls % self.every == 0
         if self.probability is not None:
@@ -87,7 +96,26 @@ def _parse_entry(entry: str, seed: int) -> _Fault:
             raise FaultSpecError(f"FAULTS entry {entry!r}: delay needs '=seconds'") from None
     elif value:
         raise FaultSpecError(f"FAULTS entry {entry!r}: only delay takes '=value'")
-    if param:
+    if param.startswith("window="):
+        lo_s, sep2, hi_s = param[len("window="):].partition(":")
+        if not sep2:
+            raise FaultSpecError(
+                f"FAULTS entry {entry!r}: window needs 'N:M' (M empty = open-ended)"
+            )
+        try:
+            fault.window_lo = int(lo_s)
+            fault.window_hi = int(hi_s) if hi_s else None
+        except ValueError:
+            raise FaultSpecError(
+                f"FAULTS entry {entry!r}: window bounds must be integers"
+            ) from None
+        if fault.window_lo < 1 or (
+            fault.window_hi is not None and fault.window_hi < fault.window_lo
+        ):
+            raise FaultSpecError(
+                f"FAULTS entry {entry!r}: window needs 1 <= N <= M"
+            )
+    elif param:
         try:
             num = float(param)
         except ValueError:

@@ -143,6 +143,38 @@ def load_snapshot(path: str, store: VectorStore) -> dict:
     return manifest
 
 
+def latest_snapshot(root: str) -> str | None:
+    """Most recently written snapshot directory under ``root`` (by
+    manifest mtime) — the fleet controller's activate-from-snapshot
+    source.  ``root`` itself may be a snapshot directory; returns None
+    when nothing restorable exists (the spare activates cold)."""
+    if not root or not os.path.isdir(root):
+        return None
+    best, best_t = None, -1.0
+    for name in sorted(os.listdir(root)):
+        mf = os.path.join(root, name, "manifest.json")
+        if os.path.isfile(mf):
+            t = os.path.getmtime(mf)
+            if t > best_t:
+                best, best_t = os.path.join(root, name), t
+    if best is None and os.path.isfile(os.path.join(root, "manifest.json")):
+        return root
+    return best
+
+
+def restore_for_activation(root: str, store: VectorStore, log=None) -> dict | None:
+    """Warm-spare bring-up: find the latest snapshot under ``root`` and
+    ``restore_replica`` it into ``store`` (snapshot + log-suffix replay).
+    Returns the restore result with the chosen path, or None when no
+    snapshot exists — the controller then activates the spare cold."""
+    path = latest_snapshot(root)
+    if path is None:
+        return None
+    out = restore_replica(path, store, log=log)
+    out["path"] = path
+    return out
+
+
 def restore_replica(path: str, store: VectorStore, log=None,
                     replay_batch: int = 256) -> dict:
     """Snapshot restore + log-suffix replay in one call: load the

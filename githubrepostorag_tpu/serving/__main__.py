@@ -179,7 +179,14 @@ async def serve(host: str, port: int) -> None:
             eng = build_engine(m)
             eng.warmup()
             engines.append(eng)
-        async_engine = MultiAsyncEngine(engines)
+        # FLEET_SPARES trailing replicas boot warm (weights loaded,
+        # programs compiled) but admit nothing until the controller — or
+        # POST /debug/fleet/activate — promotes them
+        spares = max(0, min(s.fleet_spares, plan.dp - 1))
+        if spares:
+            logger.info("fleet: %d active + %d warm spare replica(s)",
+                        plan.dp - spares, spares)
+        async_engine = MultiAsyncEngine(engines, spares=spares)
     else:
         mesh = make_mesh(plan) if plan.n_devices > 1 else None
         if mesh is not None:
@@ -201,6 +208,25 @@ async def serve(host: str, port: int) -> None:
         async_engine = AsyncEngine(engine)
     server = OpenAIServer(async_engine, tokenizer, model_name=s.qwen_model)
     bound = await server.start(host=host, port=port)
+    controller = None
+    if s.ctrl == "on" and plan.dp > 1:
+        # close the SLO loop: sense (ledger/burn/liveness) -> decide
+        # (guarded action ladder) -> act (grow pool / shift spec-k /
+        # spread affinity / fence + warm-spare failover).  Fleet-shaped
+        # only: a single replica has no spare to fail over to.
+        from githubrepostorag_tpu.serving.controller import FleetController
+
+        restore = None
+        if s.ctrl_snapshot_dir:
+            from githubrepostorag_tpu.retrieval.snapshot import (
+                restore_for_activation)
+            from githubrepostorag_tpu.store.factory import get_store
+
+            restore = lambda: restore_for_activation(  # noqa: E731
+                s.ctrl_snapshot_dir, get_store())
+        controller = FleetController(async_engine, restore=restore)
+        await controller.start()
+        logger.info("fleet controller up (tick %.2fs)", controller.tick_s)
     logger.info("model server up on %s:%d (backend=%s)", host, bound, jax.default_backend())
     while True:  # serve until the pod is killed
         await asyncio.sleep(3600)

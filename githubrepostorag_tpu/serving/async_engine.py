@@ -83,6 +83,17 @@ class AsyncEngine:
         # lifecycle is event-loop state: MultiAsyncEngine transitions it and
         # its _pick reads it, both on the loop; other threads only render it
         self.lifecycle = "active"
+        # liveness probe state: the driver stamps ``heartbeat`` (a
+        # time.monotonic reading) at the top of every iteration; the fleet
+        # controller reads its age cross-thread (GIL-atomic float) and a
+        # fault-killed driver leaves its terminal error in ``driver_error``
+        self.heartbeat: float | None = None
+        self.driver_error: str | None = None
+        # last successfully collected stats + collection time, served with
+        # a ``stale_since`` age when the driver lock can't be acquired
+        # within the stats deadline (a wedged driver must not hang /debug)
+        self._last_stats: dict[str, Any] | None = None
+        self._last_stats_t: float | None = None
         # serving role under disaggregation ("fused" | "prefill" | "decode");
         # MultiAsyncEngine assigns it at fleet construction and it never
         # changes while the replica is active, so reads are safe anywhere
@@ -245,9 +256,29 @@ class AsyncEngine:
         digest_next = 0.0
         pressure_next = 0.0  # SLO class-state push, rate-limited like digest
 
+        from githubrepostorag_tpu.resilience.faults import (
+            InjectedFault, fire_sync)
+
+        # per-replica chaos seam: ``fleet.step.rN:delay=S`` wedges this
+        # driver (it sleeps holding the lock), ``error`` kills it (the
+        # thread records the fault and exits — a dead replica); paired
+        # with @window=N:M a test scripts healthy-then-dies deterministically
+        fault_site = f"fleet.step.{R}"
+
         while not self._stop:
             step_start = time.monotonic()
+            # tpulint: disable=WPA002 -- GIL-atomic float stamp; the controller's liveness probe only compares its age against a multi-second timeout, so torn ordering is harmless
+            self.heartbeat = step_start
             with self._lock:
+                try:
+                    fire_sync(fault_site)
+                except InjectedFault as exc:
+                    # a killed driver is the chaos model for a dead replica:
+                    # leave the evidence and exit; the controller's liveness
+                    # probe sees thread-dead + stale heartbeat and fails over
+                    self.driver_error = str(exc)
+                    logger.error("replica %s driver killed: %s", R, exc)
+                    return
                 if (time.monotonic() >= pressure_next
                         and hasattr(self.engine, "set_class_pressure")):
                     # burn-rate states feed the engine's preempt triggers
@@ -314,6 +345,33 @@ class AsyncEngine:
             if not has_work:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
+
+    def driver_alive(self) -> bool:
+        """True while the driver thread exists and is running.  A FAULTS-
+        killed driver (InjectedFault at ``fleet.step.rN``) exits its thread,
+        so this flips false without stop() ever being called."""
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def fail_in_flight(self, reason: str) -> list[str]:
+        """Fail every in-flight request with the standard error frame (a
+        final GenerationResult with finish_reason="error") so no caller
+        ever hangs on a dead or wedged driver.
+
+        Runs on the event loop and deliberately does NOT take the driver
+        lock — the whole point is that the driver may be wedged holding
+        it.  The queues dict is only mutated under the GIL; a racing final
+        from a still-twitching driver is harmless (the consumer returns on
+        whichever final arrives first and drops its queue)."""
+        failed: list[str] = []
+        for rid, q in list(self._queues.items()):
+            res = GenerationResult(
+                request_id=rid, prompt_tokens=[], output_tokens=[],
+                finish_reason="error", error=reason,
+            )
+            q.put_nowait(StreamEvent(type="final", result=res))
+            failed.append(rid)
+        return failed
 
     def _emit(self, rid: str, event: StreamEvent) -> None:
         q = self._queues.get(rid)
@@ -418,8 +476,25 @@ class AsyncEngine:
         return await asyncio.get_running_loop().run_in_executor(None, work)
 
     def stats(self) -> dict[str, Any]:
-        with self._lock:
-            return {
+        from githubrepostorag_tpu.config import get_settings
+        from githubrepostorag_tpu.resilience.policy import Deadline
+
+        # bounded collection: a wedged driver holds the lock for seconds;
+        # /debug/fleet must render the last good row with its age instead
+        # of hanging behind it (Deadline: resilience/policy.py)
+        deadline = Deadline(get_settings().ctrl_stats_timeout_s)
+        if not self._lock.acquire(timeout=max(0.0, deadline.remaining())):
+            now = time.monotonic()
+            stale: dict[str, Any] = (
+                dict(self._last_stats) if self._last_stats
+                else {"role": self.role})
+            since = (self._last_stats_t if self._last_stats_t is not None
+                     else (self.heartbeat if self.heartbeat is not None
+                           else now))
+            stale["stale_since"] = round(now - since, 3)
+            return stale
+        try:
+            out = {
                 "role": self.role,
                 "running": self.engine.num_running,
                 "waiting": self.engine.num_waiting,
@@ -461,3 +536,8 @@ class AsyncEngine:
                 "resume_recomputed_prompt_tokens": getattr(
                     self.engine, "resume_recomputed_prompt_tokens", 0),
             }
+        finally:
+            self._lock.release()
+        self._last_stats = out
+        self._last_stats_t = time.monotonic()
+        return dict(out)

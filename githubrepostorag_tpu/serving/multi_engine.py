@@ -116,6 +116,14 @@ class MultiAsyncEngine:
         ]
         self._by_id = {ae.replica: ae for ae in self._engines}
         self._route: dict[str, AsyncEngine] = {}
+        # in-flight lifecycle operation per replica: a second drain() or
+        # activate() awaits the running task instead of racing it (the
+        # controller retries on every tick, so idempotence is load-bearing)
+        self._ops: dict[str, asyncio.Task] = {}
+        # affinity load-slack is a controller actuator: lowering it makes
+        # the router abandon a prefix-hot replica sooner, spreading hot
+        # tenants when a replica's limiter says it stalls on swap_wait
+        self.affinity_slack: float = AFFINITY_LOAD_SLACK
         self._ids = itertools.count()
         self._rr = itertools.count()  # round_robin policy cursor
         self._policy = policy
@@ -175,14 +183,44 @@ class MultiAsyncEngine:
         return (ae.engine.num_running + ae.engine.num_waiting
                 + self._pending.get(ae.replica, 0))
 
+    async def _lifecycle_op(self, replica: str, verb: str,
+                            impl) -> dict[str, Any]:
+        """Serialize lifecycle verbs per replica and make repeats no-ops:
+        a second ``drain`` (or ``activate``) while one is in flight awaits
+        the SAME task and returns its result; an opposing verb queues
+        behind the running one instead of interleaving with it.  Shielded
+        so one cancelled caller can't abort the shared operation."""
+        name = f"{verb}-{replica}"
+        while True:
+            op = self._ops.get(replica)
+            if op is None or op.done():
+                break
+            if op.get_name() == name:
+                return await asyncio.shield(op)
+            # drain-then-activate (or the reverse) race: let the running
+            # op finish, then re-check state from scratch
+            try:
+                await asyncio.shield(op)
+            except Exception:  # noqa: BLE001 - the first caller surfaces it
+                pass
+        task = asyncio.get_running_loop().create_task(impl(), name=name)
+        self._ops[replica] = task
+        return await asyncio.shield(task)
+
     async def drain(self, replica: str) -> dict[str, Any]:
         """Stop admitting on ``replica``, let in-flight requests finish,
         then write cached pages back to the host tier so a later activate
         (or a peer's fault-in path, once pages are cross-replica) starts
         warm.  Resolves even if the replica dies mid-drain (chaos seam
         ``fleet.drain``): the corpse is force-stopped and still counts as
-        drained — it admits nothing either way."""
+        drained — it admits nothing either way.  Idempotent: a concurrent
+        drain of the same replica joins the in-flight one."""
         ae = self._by_id[replica]
+        return await self._lifecycle_op(
+            replica, "drain", lambda: self._drain_impl(ae))
+
+    async def _drain_impl(self, ae: AsyncEngine) -> dict[str, Any]:
+        replica = ae.replica
         if ae.lifecycle == "drained":
             return {"replica": replica, "lifecycle": "drained", "waited": 0}
         self._set_lifecycle(ae, "draining")
@@ -220,12 +258,66 @@ class MultiAsyncEngine:
             engine.flush_kv_migrations()
 
     async def activate(self, replica: str) -> dict[str, Any]:
-        """Bring a warm spare or drained replica (back) into rotation."""
+        """Bring a warm spare or drained replica (back) into rotation.
+        Idempotent: activating an already-active replica is a no-op, and a
+        concurrent activate joins the in-flight one."""
         ae = self._by_id[replica]
+        return await self._lifecycle_op(
+            replica, "activate", lambda: self._activate_impl(ae))
+
+    async def _activate_impl(self, ae: AsyncEngine) -> dict[str, Any]:
+        replica = ae.replica
+        if ae.lifecycle == "active" and ae.driver_alive():
+            return {"replica": replica, "lifecycle": "active"}
         self._set_lifecycle(ae, "active")
         await ae.start()
         _span().add_event("fleet.activate", replica=replica)
         return {"replica": replica, "lifecycle": "active"}
+
+    async def fence(self, replica: str) -> dict[str, Any]:
+        """Emergency isolation for a dead/wedged replica: stop admission
+        (lifecycle -> draining, so ``_pick`` skips it) and fail its
+        in-flight work with the standard error frame — the hand-back that
+        lets callers retry through the router instead of hanging on a
+        driver that will never step again.  Unlike ``drain`` this never
+        waits on the victim."""
+        ae = self._by_id[replica]
+        if ae.lifecycle in ("active", "spare"):
+            self._set_lifecycle(ae, "draining")
+        failed = ae.fail_in_flight(
+            f"replica {replica} fenced by fleet controller")
+        for rid in failed:
+            self._route.pop(rid, None)
+        self._breakers[replica].record_failure()
+        _span().add_event("fleet.fence", replica=replica, failed=len(failed))
+        return {"replica": replica, "lifecycle": ae.lifecycle,
+                "failed": len(failed)}
+
+    async def retire(self, replica: str) -> dict[str, Any]:
+        """Force-stop a fenced corpse without waiting for in-flight work
+        (``fence`` already failed it) — ``drain``'s escape hatch for a
+        driver that can no longer make progress."""
+        ae = self._by_id[replica]
+        await ae.stop()
+        self._set_lifecycle(ae, "drained")
+        _span().add_event("fleet.retire", replica=replica)
+        return {"replica": replica, "lifecycle": "drained"}
+
+    def replicas(self) -> list[AsyncEngine]:
+        """The fleet's AsyncEngine rows (the controller's sense loop reads
+        lifecycle/heartbeat/driver_alive off them)."""
+        return list(self._engines)
+
+    def spare_replicas(self) -> list[str]:
+        return [ae.replica for ae in self._engines
+                if ae.lifecycle == "spare"]
+
+    def set_affinity_slack(self, slack: float) -> float:
+        """Controller actuator for ``swap_wait`` remediation: clamp and set
+        the affinity load-slack (floor 0.5 keeps affinity from degrading
+        into pure least-loaded)."""
+        self.affinity_slack = max(0.5, float(slack))
+        return self.affinity_slack
 
     # ------------------------------------------------------------- routing
 
@@ -285,7 +377,7 @@ class MultiAsyncEngine:
                 ranked = [t[0] for t in sorted(
                     hits, key=lambda t: (-t[2], self._load(t[0])))]
                 floor = min(self._load(ae) for ae in cands)
-                if self._load(ranked[0]) - floor > AFFINITY_LOAD_SLACK:
+                if self._load(ranked[0]) - floor > self.affinity_slack:
                     # the hit replica is saturated: the queue wait behind
                     # the whole burst costs more than the saved prefill
                     decision = "affinity_miss"
@@ -702,6 +794,7 @@ class MultiAsyncEngine:
             }
         return {
             "policy": self._policy or get_settings().route_affinity,
+            "affinity_slack": self.affinity_slack,
             "decisions": dict(self._decisions),
             "per_replica": per,
             "disagg": self.disagg_stats(),

@@ -86,6 +86,7 @@ def build_payloads():
     # registers it via SLOPlane.set_router_info)
     plane.set_router_info(lambda: {
         "policy": "auto",
+        "affinity_slack": 4.0,
         "decisions": {"affinity_hit": 1, "affinity_miss": 1,
                       "skipped_breaker_open": 0, "skipped_limiter": 0},
         "per_replica": {"r0": {
@@ -107,6 +108,36 @@ def build_payloads():
             "transport": {"kind": "in_process", "burst": 32,
                           "transfers": 1, "chunks": 1},
         },
+    })
+    # the same shape FleetController.payload() renders (the controller
+    # registers it via SLOPlane.set_controller_info): action-log ring with
+    # the ledger-window + burn-state justification stamp, guard counters,
+    # cooldowns, hysteresis state
+    plane.set_controller_info(lambda: {
+        "tick_s": 1.0,
+        "ticks": 12,
+        "running": True,
+        "actions_total": 1,
+        "failopen": 0,
+        "suppressed": {"hysteresis": 1, "cooldown": 0, "budget": 0,
+                       "inflight": 0},
+        "budget": {"max_actions": 4, "window_s": 300.0, "used": 1},
+        "hysteresis": {"required_ticks": 2,
+                       "pending": {"r0:failover:dead": 1}},
+        "cooldowns": {"r0:failover": 28.5},
+        "log": [{
+            "t": 12.0, "replica": "r0", "action": "failover",
+            "reason": "dead", "status": "dispatched",
+            "justification": {
+                "ledger": ledger.justification(now),
+                "burn": monitor.burn_state(now),
+                "liveness": {"started": True, "thread_alive": False,
+                             "heartbeat_age_s": 6.2, "driver_error": None,
+                             "breaker": "closed"},
+            },
+            "detail": {"victim": "r0", "spare": "r2", "no_spare": False,
+                       "trigger": "dead"},
+        }],
     })
     return plane.slo_payload(), plane.fleet_payload()
 

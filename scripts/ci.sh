@@ -99,6 +99,16 @@ echo "== fused-step A/B (CPU-tiny) =="
 # inside the 2% obs budget.
 BENCH_ONLY=fused JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
 
+echo "== self-healing fleet-controller A/B (CPU-tiny) =="
+# controller on vs off against the same mid-run FAULTS replica kill over
+# identical 2-active + 1-warm-spare fleets: bench_controller_pair asserts
+# the controller arm recovers >= 0.8x pre-kill goodput with zero hung
+# requests (the fence fails in-flight work with error frames) and a
+# justification-stamped failover in the action log, while the
+# no-controller arm collapses below the same bar with requests hung to
+# timeout against the corpse.
+BENCH_ONLY=controller JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly

@@ -21,6 +21,7 @@ from githubrepostorag_tpu.events.base import ProgressBus, channel_for
 from githubrepostorag_tpu.llm import FakeLLM
 from githubrepostorag_tpu.metrics import (
     BUS_RECONNECTS,
+    CTRL_ACTIONS,
     EVENT_EMIT_DROPS,
     FAULTS_INJECTED,
     JOBS_SHED,
@@ -106,6 +107,44 @@ def test_probability_faults_are_seeded(monkeypatch):
 def test_malformed_specs_raise_at_parse():
     for bad in ("nosite", "x:frobnicate", "x:delay", "x:drop@0", "x:drop@1.5",
                 "x:drop=3", ":drop", "x:"):
+        with pytest.raises(FaultSpecError):
+            _parse_entry(bad, seed=0)
+
+
+def test_window_fault_fires_only_inside_the_window(monkeypatch):
+    """``@window=N:M`` scripts "healthy, then dies, then recovers" at one
+    site: calls 3..5 fire, everything before and after passes clean."""
+    _enable(monkeypatch, "w.site:drop@window=3:5")
+    fired = [fire_sync("w.site") for _ in range(7)]
+    assert fired == [False, False, True, True, True, False, False]
+    assert counter_value(FAULTS_INJECTED, site="w.site", action="drop") >= 3
+
+
+def test_open_ended_window_kills_permanently(monkeypatch):
+    """``@window=N:`` (no upper bound) models a replica that dies at call
+    N and never comes back — the controller chaos e2e's kill switch."""
+    _enable(monkeypatch, "w.site:error@window=2:")
+    assert fire_sync("w.site") is False
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            fire_sync("w.site")
+
+
+def test_window_composes_with_delay_value(monkeypatch):
+    _enable(monkeypatch, "w.site:delay=0.05@window=2:2")
+    t0 = time.monotonic()
+    assert fire_sync("w.site") is False  # call 1: outside, no sleep
+    assert time.monotonic() - t0 < 0.04
+    t0 = time.monotonic()
+    assert fire_sync("w.site") is False  # call 2: delay fires, then proceeds
+    assert time.monotonic() - t0 >= 0.04
+    assert fire_sync("w.site") is False  # call 3: outside again
+
+
+def test_window_parse_errors():
+    for bad in ("x:drop@window=", "x:drop@window=3", "x:drop@window=0:2",
+                "x:drop@window=5:3", "x:drop@window=a:b",
+                "x:drop@window=1.5:2"):
         with pytest.raises(FaultSpecError):
             _parse_entry(bad, seed=0)
 
@@ -976,6 +1015,122 @@ def test_saturating_load_interactive_ttft_recovers_batch_finishes(
         assert eng._allocator.free_count == eng._allocator.num_pages
     finally:
         admission.clear_table_provider()
+
+
+async def test_controller_chaos_killed_replica_recovers_via_spare(
+        tiny_model, monkeypatch, tmp_path):
+    """The PR's acceptance bar, end to end: FAULTS kills r0's driver at a
+    scripted step (``fleet.step.r0:error@window=3:``) while the fleet is
+    under load; the real FleetController must sense the dead driver, fence
+    the victim (its in-flight requests fail with the standard error frame,
+    never hang), restore the latest index snapshot, activate the warm
+    spare, and retire the corpse — after which goodput recovers.  Zero
+    requests are lost except the victim's in-flight ones."""
+    import jax.numpy as jnp
+
+    from githubrepostorag_tpu.retrieval.snapshot import (
+        restore_for_activation,
+        save_snapshot,
+    )
+    from githubrepostorag_tpu.serving import Engine, SamplingParams
+    from githubrepostorag_tpu.serving.controller import FleetController
+    from githubrepostorag_tpu.serving.multi_engine import MultiAsyncEngine
+    from githubrepostorag_tpu.store import MemoryVectorStore
+
+    params, cfg = tiny_model
+
+    def _eng():
+        return Engine(params, cfg, max_num_seqs=4, num_pages=32, page_size=8,
+                      max_seq_len=64, kv_dtype=jnp.float32)
+
+    # a snapshot for the spare to warm up from (the controller's restore
+    # hook records its invocation and restores into a fresh store)
+    source = MemoryVectorStore()
+    enc = HashingTextEncoder()
+    text = "def handler(req): route and serve"
+    source.upsert("embeddings", [Doc(
+        "d1", text, {"namespace": "default", "scope": "chunk"},
+        enc.encode([text])[0])])
+    save_snapshot(source, str(tmp_path / "snap-001"), watermark=7)
+
+    restored_into = MemoryVectorStore()
+    restore_calls: list[dict] = []
+
+    def restore():
+        out = restore_for_activation(str(tmp_path), restored_into)
+        restore_calls.append(out)
+        return out
+
+    # r0 dies on its 3rd driver iteration — mid-generation of whatever it
+    # holds; open-ended window so a restarted driver would die again
+    # liveness timeout sits ABOVE the CPU backend's first-step compile
+    # stall (several seconds holding the driver lock): this test's trigger
+    # is genuine thread death ("dead"), not a heartbeat age ("wedged")
+    _enable(monkeypatch, "fleet.step.r0:error@window=3:",
+            CTRL_TICK_S="0.05", CTRL_HYSTERESIS_TICKS="2",
+            CTRL_COOLDOWN_S="0.1", CTRL_LIVENESS_TIMEOUT_S="30",
+            CTRL_MAX_ACTIONS="4", CTRL_ACTION_WINDOW_S="60")
+    multi = MultiAsyncEngine([_eng(), _eng(), _eng()], spares=1)
+    assert multi.spare_replicas() == ["r2"]
+    ctrl = FleetController(multi, restore=restore)
+    await ctrl.start()
+    sp = SamplingParams(temperature=0.0, max_tokens=12, stop_token_ids=())
+    prompts = [[1 + i, 2 + i, 3 + i, 4 + i] for i in range(8)]
+    try:
+        # wave 1: r0 dies under this load.  Every request must resolve —
+        # the victim's in-flight ones with an error frame, the rest clean.
+        wave1 = await asyncio.wait_for(
+            asyncio.gather(*(multi.generate(p, sp) for p in prompts)),
+            timeout=120)
+        assert len(wave1) == 8
+        errors = [r for r in wave1 if r.finish_reason == "error"]
+        clean = [r for r in wave1 if r.finish_reason != "error"]
+        assert errors, "the killed replica held no in-flight work"
+        assert all("fenced by fleet controller" in r.error for r in errors)
+        assert all(r.finish_reason in ("length", "stop") for r in clean)
+
+        # the controller converges: spare active, corpse retired
+        for _ in range(400):
+            if (multi._by_id["r2"].lifecycle == "active"
+                    and multi._by_id["r0"].lifecycle == "drained"):
+                break
+            await asyncio.sleep(0.025)
+        assert multi._by_id["r2"].lifecycle == "active"
+        assert multi._by_id["r2"].driver_alive()
+        assert multi._by_id["r0"].lifecycle == "drained"
+        assert not multi._by_id["r0"].driver_alive()
+        assert multi._by_id["r0"].driver_error  # the injected kill, recorded
+
+        # the spare warmed up from the snapshot, not cold
+        assert restore_calls and restore_calls[0]["replayed"] == 0
+        assert restore_calls[0]["manifest"]["watermark"]["seq"] == 7
+        assert restored_into.find_by_metadata("embeddings", {}, limit=10)
+
+        # the action was justified and published: ledger window + burn
+        # state + liveness ride the log entry and /debug/fleet
+        section = multi.fleet()["controller"]
+        fo = [e for e in section["log"] if e["action"] == "failover"
+              and e["status"] == "dispatched"]
+        assert fo, section["log"]
+        just = fo[0]["justification"]
+        assert just["liveness"]["thread_alive"] is False
+        assert just["ledger"]["window_s"] > 0
+        assert just["burn"]["state"] in ("ok", "warn", "critical")
+        assert fo[0]["reason"] == "dead"
+        assert counter_value(
+            CTRL_ACTIONS, action="failover", reason=fo[0]["reason"]) >= 1
+
+        # wave 2: goodput recovers on r1 + the activated spare
+        wave2 = await asyncio.wait_for(
+            asyncio.gather(*(multi.generate(p, sp) for p in prompts[:4])),
+            timeout=120)
+        assert all(r.finish_reason in ("length", "stop") for r in wave2)
+        per = multi.router_stats()["per_replica"]
+        assert per["r2"]["routed"] >= 1  # the spare is genuinely serving
+        assert per["r0"]["lifecycle"] == "drained"
+    finally:
+        ctrl.stop()
+        await multi.stop()
 
 
 def test_admission_decide_fault_injection_fails_open_and_counts(monkeypatch):

@@ -317,6 +317,106 @@ async def test_warm_spare_activation_restores_capacity(tiny):
         await multi.stop()
 
 
+async def test_double_drain_joins_one_operation(tiny):
+    """Idempotence: two concurrent drains of the same replica share ONE
+    task — same result object, no interleaved second writeback."""
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)])
+    try:
+        multi._pending["r0"] = 1  # holds _in_flight > 0: drain spins
+        t1 = asyncio.create_task(multi.drain("r0"))
+        t2 = asyncio.create_task(multi.drain("r0"))
+        await asyncio.sleep(0.05)
+        assert not t1.done() and not t2.done()
+        assert multi._by_id["r0"].lifecycle == "draining"
+        multi._pending["r0"] = 0
+        r1, r2 = await asyncio.gather(t1, t2)
+        assert r1 is r2  # the same operation's result, not a re-run
+        assert r1["lifecycle"] == "drained" and r1["waited"] >= 1
+    finally:
+        await multi.stop()
+
+
+async def test_double_activate_joins_one_operation(tiny):
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)],
+                             spares=1)
+    try:
+        t1 = asyncio.create_task(multi.activate("r1"))
+        t2 = asyncio.create_task(multi.activate("r1"))
+        r1, r2 = await asyncio.gather(t1, t2)
+        assert r1 is r2 and r1["lifecycle"] == "active"
+        assert multi._by_id["r1"].lifecycle == "active"
+    finally:
+        await multi.stop()
+
+
+async def test_drain_then_activate_race_serializes(tiny):
+    """An activate issued while a drain is in flight must queue behind it
+    (never interleave with the writeback), then run — final state is a
+    clean re-activation, and the replica still serves."""
+    cfg, params = tiny
+    sp = SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=())
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)])
+    try:
+        multi._pending["r0"] = 1
+        d = asyncio.create_task(multi.drain("r0"))
+        await asyncio.sleep(0.02)
+        a = asyncio.create_task(multi.activate("r0"))
+        await asyncio.sleep(0.05)
+        assert not a.done()  # queued behind the running drain
+        multi._pending["r0"] = 0
+        out_d, out_a = await asyncio.gather(d, a)
+        assert out_d["lifecycle"] == "drained"
+        assert out_a["lifecycle"] == "active"
+        assert multi._by_id["r0"].lifecycle == "active"
+        r = await multi.generate(_prompts(1)[0], sp)
+        assert r.finish_reason in ("length", "stop")
+    finally:
+        await multi.stop()
+
+
+async def test_stats_deadline_yields_stale_row_for_wedged_driver(tiny, monkeypatch):
+    """Satellite regression: fleet stats() used to block on a wedged
+    replica's driver lock (held for the whole injected delay).  Now the
+    per-replica collection runs under a Deadline and a blocked replica
+    yields its cached row + ``stale_since`` instead of hanging /debug."""
+    import time
+
+    from tests.test_chaos import _enable
+
+    cfg, params = tiny
+    multi = MultiAsyncEngine([_engine(params, cfg) for _ in range(2)])
+    try:
+        fresh = multi.stats()  # populate the stats cache for both replicas
+        assert all("stale_since" not in row for row in fresh["per_replica"])
+        # wedge r1's driver: each iteration sleeps 0.8s HOLDING the lock
+        _enable(monkeypatch, "fleet.step.r1:delay=0.8",
+                CTRL_STATS_TIMEOUT_S="0.1")
+        await multi._by_id["r1"].start()
+        stale = None
+        for _ in range(50):
+            t0 = time.monotonic()
+            snap = multi.stats()
+            assert time.monotonic() - t0 < 0.75  # never a full wedge-wait
+            row = snap["per_replica"][1]
+            if "stale_since" in row:
+                stale = row
+                break
+        assert stale is not None, "wedged replica never reported stale"
+        assert stale["stale_since"] >= 0.0
+        assert stale["role"] == "fused"  # cached content, not an empty row
+        # the healthy replica's row stays live alongside the stale one
+        assert "stale_since" not in snap["per_replica"][0]
+    finally:
+        monkeypatch.setenv("FAULTS", "")
+        from githubrepostorag_tpu.config import reload_settings
+        from githubrepostorag_tpu.resilience.faults import reset_faults
+        reload_settings()
+        reset_faults()
+        await multi.stop()
+
+
 async def test_fleet_lifecycle_endpoints(tiny):
     """POST /debug/fleet/drain + /activate drive the lifecycle over HTTP
     and /debug/fleet renders router + lifecycle state."""
