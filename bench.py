@@ -430,6 +430,49 @@ def _slo_overhead_pct(wall_s: float, n_steps: int, n_requests: int) -> float:
     return 100.0 * total / max(wall_s, 1e-9)
 
 
+def _deep_obs_overhead_pct(wall_s: float, n_steps: int,
+                           n_requests: int) -> float:
+    """Estimated page-observatory + continuous-profiler overhead as a % of
+    the scenario wall: measured per-call cost of the three seams the deep
+    observability rides — the allocator's claims delta (twice per request:
+    admission claim + recycle release), the engine's request hold/release
+    attribution pair (once per request), and the profiler's sampled
+    ``on_step`` (once per engine step; the modulo fast path is the common
+    case at PROFILE_SAMPLE_EVERY=32, so the measured cost includes 31
+    skips per recorded sample)."""
+    from githubrepostorag_tpu.obs.continuous import ContinuousProfiler
+    from githubrepostorag_tpu.obs.hbm import PageObservatory
+
+    obs = PageObservatory("bench-overhead")
+    base = time.monotonic()
+    N = 2000
+    t0 = time.monotonic()
+    for i in range(N):
+        t = base + i * 1e-3
+        obs.on_claims(4, now=t)
+        obs.on_claims(-4, now=t + 5e-4)
+    claims_cost = (time.monotonic() - t0) / (2 * N)
+    M = 1000
+    t0 = time.monotonic()
+    for i in range(M):
+        t = base + i * 1e-2
+        obs.on_request_hold(f"bench-{i}", "interactive", 4, now=t)
+        obs.on_request_release(f"bench-{i}", now=t + 5e-3)
+    request_cost = (time.monotonic() - t0) / M
+    prof = ContinuousProfiler("bench-overhead", sample_every=32, ring=512)
+    rec = {"prefill": 0.0, "decode": 1e-3, "wall": 1.2e-3,
+           "committed": 8.0, "compiles": 0.0}
+    S = 4096
+    t0 = time.monotonic()
+    for i in range(S):
+        prof.on_step(base + i * 1e-3, rec, queue=(4, 2, 0), pool=(30, 2))
+    prof_cost = (time.monotonic() - t0) / S
+    total = (claims_cost * 2 * max(1, n_requests)
+             + request_cost * max(1, n_requests)
+             + prof_cost * max(1, n_steps))
+    return 100.0 * total / max(wall_s, 1e-9)
+
+
 def bench_concurrency(cfg, *, streams: int, prompt_len, gen_tokens: int,
                       engine, trials: int = 1,
                       seed0: int = 1) -> tuple[float, float, dict]:
@@ -491,6 +534,18 @@ def bench_concurrency(cfg, *, streams: int, prompt_len, gen_tokens: int,
         raise RuntimeError(
             f"SLO ledger+monitor overhead {slo_pct:.2f}% of scenario wall "
             "exceeds the 2% budget (on_step/observe fast path regressed?)"
+        )
+    deep_pct = _deep_obs_overhead_pct(phases["wall_s"], phases["n_steps"],
+                                      streams)
+    phases["deep_obs_overhead_pct"] = round(deep_pct, 4)
+    if deep_pct > 2.0:
+        # same budget for the page observatory + continuous profiler: the
+        # claims/hold seams ride the allocator and the sampler rides the
+        # driver loop, and neither may cost the HBM they account for
+        raise RuntimeError(
+            f"page-observatory+profiler overhead {deep_pct:.2f}% of "
+            "scenario wall exceeds the 2% budget (claims seam or sampler "
+            "fast path regressed?)"
         )
     return agg, p50, phases
 
@@ -1941,6 +1996,11 @@ def bench_controller_pair(tag: str, *, pre: int = 64, post: int = 64,
             out["per_replica"] = {
                 r: {"lifecycle": v["lifecycle"], "routed": v["routed"]}
                 for r, v in multi.router_stats()["per_replica"].items()}
+            if arm == "on":
+                # one Perfetto trace for the whole incident, exported while
+                # the fleet/controller providers are still registered
+                from githubrepostorag_tpu.obs.timeline import build_timeline
+                out["timeline"] = build_timeline(window_s=120.0)
         finally:
             os.environ.pop("FAULTS", None)
             reload_settings()
@@ -2002,6 +2062,35 @@ def bench_controller_pair(tag: str, *, pre: int = 64, post: int = 64,
           and e["replica"] == "r0"]
     assert fo and fo[0]["justification"]["liveness"]["thread_alive"] is False, \
         "failover action missing its liveness justification stamp"
+    # the incident timeline: the failover must be readable off the trace
+    # alone — controller action slice, the victim's fenced requests, and
+    # step-anatomy tracks for more than one replica
+    tl = on.pop("timeline")
+    golden_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tests", "golden",
+                               "debug_timeline_schema.json")
+    golden_kinds = set(json.load(open(golden_path))
+                       ["GET /debug/timeline traceEvents"])
+    evs = [e for e in tl["traceEvents"] if e["ph"] != "M"]
+    unknown = {f"{e['ph']}:{e.get('cat', '')}" for e in evs
+               if e["ph"] != "C"
+               and f"{e['ph']}:{e.get('cat', '')}" not in golden_kinds}
+    assert not unknown, \
+        f"timeline emitted event kinds absent from the golden: {unknown}"
+    assert any(e.get("cat") == "controller" and e["name"] == "ctrl.failover"
+               for e in evs), "controller failover slice missing"
+    fenced = [e for e in evs if e.get("cat") == "fence"]
+    assert fenced, "victim's fenced-request instants missing"
+    step_replicas = {e["pid"] for e in evs if e.get("cat") == "step"}
+    assert len(step_replicas) >= 2, \
+        f"step-anatomy tracks for only {len(step_replicas)} replica(s)"
+    os.makedirs("artifacts", exist_ok=True)
+    with open(os.path.join("artifacts", "timeline.json"), "w") as f:
+        json.dump(tl, f, default=str)
+    log(f"bench[{tag}]: incident timeline: {len(evs)} events, "
+        f"{len(fenced)} fenced request(s), step tracks for "
+        f"{len(step_replicas)} replicas -> artifacts/timeline.json "
+        "(load in ui.perfetto.dev)")
     speedup = on["recovery_ratio"] / max(off["recovery_ratio"], 1e-9)
     emit(f"{tag}_recovery_vs_off", speedup, "x", None)
     log(f"bench[{tag}]: controller recovery {on['recovery_ratio']:.2f}x vs "
@@ -2436,6 +2525,18 @@ def main() -> None:
     try:
         with maybe_trace():  # JAX_PROFILE_DIR=... python bench.py -> device trace
             _main()
+    except BaseException:
+        # a failed gate leaves the incident trace behind for the post-mortem
+        # (whatever spans/steps/events the run accumulated before dying)
+        try:
+            from githubrepostorag_tpu.obs.timeline import dump_timeline
+            os.makedirs("artifacts", exist_ok=True)
+            dump_timeline(os.path.join("artifacts", "timeline.json"))
+            log("bench: failure timeline -> artifacts/timeline.json "
+                "(load in ui.perfetto.dev)")
+        except Exception:
+            pass
+        raise
     finally:
         # even a mid-run crash leaves the partial summary in the driver tail
         finish()

@@ -116,6 +116,8 @@ class RagApi:
         app.router.add_get("/debug/slo", self.debug_slo)
         app.router.add_get("/debug/fleet", self.debug_fleet)
         app.router.add_get("/debug/index", self.debug_index)
+        app.router.add_get("/debug/hbm", self.debug_hbm)
+        app.router.add_get("/debug/timeline", self.debug_timeline)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/", self.index_redirect)
@@ -303,6 +305,24 @@ class RagApi:
         from githubrepostorag_tpu.retrieval.live_index import live_index_payload
 
         return web.json_response(live_index_payload())
+
+    async def debug_hbm(self, request: web.Request) -> web.Response:
+        from githubrepostorag_tpu.obs.hbm import get_hbm_plane
+
+        return web.json_response(get_hbm_plane().payload())
+
+    async def debug_timeline(self, request: web.Request) -> web.Response:
+        """One Perfetto trace for the recent past (?window_s= bounds it);
+        save the body and open it in ui.perfetto.dev."""
+        from githubrepostorag_tpu.obs.timeline import build_timeline
+
+        try:
+            window_s = float(request.query["window_s"]) \
+                if "window_s" in request.query else None
+        except ValueError:
+            return web.json_response(
+                {"error": "window_s must be a number"}, status=400)
+        return web.json_response(build_timeline(window_s=window_s))
 
     async def health(self, request: web.Request) -> web.Response:
         import asyncio

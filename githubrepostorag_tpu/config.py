@@ -208,6 +208,23 @@ class Settings:
     trace_max_spans: int = field(default_factory=lambda: _env_int("TRACE_MAX_SPANS", 128))
     # json (trace-stamped structured lines) | plain (human format)
     log_format: str = field(default_factory=lambda: os.getenv("LOG_FORMAT", "json"))
+    # --- Deep observability (obs/continuous.py + obs/timeline.py) ---
+    # continuous profiler: every Nth driver step captures a full step
+    # anatomy + queue depths + pool snapshot into a bounded ring (0 = off);
+    # the non-sampled steps pay one int increment + modulo
+    profile_sample_every: int = field(
+        default_factory=lambda: _env_int("PROFILE_SAMPLE_EVERY", 32))
+    # continuous-profiler ring capacity (samples retained per replica)
+    profile_ring: int = field(
+        default_factory=lambda: _env_int("PROFILE_RING", 512))
+    # default /debug/timeline export window when the request doesn't pass
+    # ?window_s= (seconds of history merged into the Perfetto trace)
+    timeline_window_s: float = field(
+        default_factory=lambda: _env_float("TIMELINE_WINDOW_S", 120.0))
+    # hard cap on exported trace events per timeline build; overflow is
+    # reported in the trace metadata, never silently dropped
+    timeline_max_events: int = field(
+        default_factory=lambda: _env_int("TIMELINE_MAX_EVENTS", 20000))
     # --- SLO plane (obs/slo.py) + token ledger (obs/ledger.py) ---
     # objectives per priority class; thresholds in ms.  p50 objective gets a
     # 50% error budget (median), p99 a 1% budget, deadline-miss its own budget

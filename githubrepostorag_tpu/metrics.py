@@ -376,6 +376,38 @@ CTRL_SUPPRESSED = Counter(
     ["guard"],
     registry=REGISTRY,
 )
+# --- Deep observability (obs/hbm.py + obs/continuous.py + obs/timeline.py)
+HBM_HELD_PAGES = Gauge(
+    "rag_hbm_held_pages",
+    "Refcount claims currently held on device pages per replica (each "
+    "block-table listing is one claim; the page observatory integrates "
+    "this over time into page-seconds)",
+    ["replica"],
+    registry=REGISTRY,
+)
+HBM_PAGE_SECONDS = Counter(
+    "rag_hbm_page_seconds_total",
+    "Page-seconds attributed to finished requests per replica and "
+    "priority class (the memory analogue of the token ledger)",
+    ["replica", "priority"],
+    registry=REGISTRY,
+)
+PROFILE_SAMPLES = Counter(
+    "rag_profile_samples_total",
+    "Continuous-profiler step samples captured into the ring per replica",
+    ["replica"],
+    registry=REGISTRY,
+)
+TIMELINE_EXPORTS = Counter(
+    "rag_timeline_exports_total",
+    "Perfetto timeline builds served (/debug/timeline + bench dumps)",
+    registry=REGISTRY,
+)
+TIMELINE_EVENTS_DROPPED = Counter(
+    "rag_timeline_events_dropped_total",
+    "Trace events dropped by the timeline_max_events cap across exports",
+    registry=REGISTRY,
+)
 # --- Disaggregated prefill/decode serving (serving/disagg.py)
 FLEET_ROLE = Gauge(
     "rag_fleet_replica_role",

@@ -29,14 +29,44 @@ from githubrepostorag_tpu.obs.slo import (
     get_slo_plane,
     reset_slo_plane,
 )
+from githubrepostorag_tpu.obs.continuous import (
+    ContinuousProfiler,
+    profilers,
+    register_profiler,
+    reset_profilers,
+    unregister_profiler,
+)
+from githubrepostorag_tpu.obs.hbm import (
+    PageObservatory,
+    get_hbm_plane,
+    reset_hbm_plane,
+)
+from githubrepostorag_tpu.obs.timeline import (
+    build_timeline,
+    dump_timeline,
+    reset_fleet_events_provider,
+    set_fleet_events_provider,
+)
 
 __all__ = [
+    "ContinuousProfiler",
     "FlightRecorder",
+    "PageObservatory",
     "SLOMonitor",
     "SLOPlane",
     "TokenLedger",
+    "build_timeline",
+    "dump_timeline",
+    "get_hbm_plane",
     "get_slo_plane",
+    "profilers",
+    "register_profiler",
+    "reset_fleet_events_provider",
+    "reset_hbm_plane",
+    "reset_profilers",
     "reset_slo_plane",
+    "set_fleet_events_provider",
+    "unregister_profiler",
     "NOOP_SPAN",
     "Span",
     "TraceContext",

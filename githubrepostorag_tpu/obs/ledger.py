@@ -121,6 +121,9 @@ class TokenLedger:
         self._m_fused = metrics.ENGINE_FUSED_STEPS.labels(replica=replica)
         self._m_dispatches = metrics.ENGINE_STEP_DISPATCHES.labels(
             replica=replica)
+        # last classified step record (GIL-atomic reference swap): the
+        # continuous profiler samples it without re-taking the lock
+        self.last_rec: dict[str, float] | None = None
 
     # ------------------------------------------------------------ feeding --
 
@@ -173,6 +176,7 @@ class TokenLedger:
                 rec["compile"] = max(0.0, wall - measured)
 
             self._append(step_end, rec)
+            self.last_rec = rec
             for k in BUCKETS + OUTCOMES + ("fused_steps",):
                 if rec[k] > 0:
                     self._pending[k] = self._pending.get(k, 0.0) + rec[k]
@@ -263,6 +267,17 @@ class TokenLedger:
         for lim, g in self._m_limiter.items():
             g.set(1.0 if lim == limiter else 0.0)
         self._last = (goodput, mfu, limiter)
+
+    def recent_steps(self, window_s: float | None = None,
+                     now: float | None = None) -> list[tuple[float, dict]]:
+        """Step records whose end time falls within the window — the
+        timeline exporter's per-step anatomy source.  Each entry is
+        (step_end_monotonic, record); a step's start is end - rec["wall"].
+        Bounded by the ledger's own retention (window_s at most)."""
+        now = time.monotonic() if now is None else now
+        cutoff = now - (self.window_s if window_s is None else window_s)
+        with self._lock:
+            return [(t, dict(rec)) for t, rec in self._steps if t >= cutoff]
 
     def current_limiter(self, now: float | None = None) -> str:
         """Cheap limiter-only read for the fleet router's fallback

@@ -62,6 +62,11 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._traces: OrderedDict[str, _TraceEntry] = OrderedDict()
         self._dropped_traces = 0
+        # high-water marks + cross-trace drop totals: /debug/traces must
+        # say when its window wrapped, not silently look complete
+        self._dropped_spans_total = 0
+        self._span_watermark = 0
+        self._trace_watermark = 0
 
     # ------------------------------------------------------------ write --
 
@@ -76,23 +81,39 @@ class FlightRecorder:
                     self._dropped_traces += 1
                 entry = _TraceEntry()
                 self._traces[span.trace_id] = entry
+                self._trace_watermark = max(self._trace_watermark,
+                                            len(self._traces))
             if entry.wall_t is None:
                 entry.wall_t = span.wall_t
             if len(entry.spans) >= self.max_spans_per_trace:
                 entry.dropped += 1
+                self._dropped_spans_total += 1
                 return
             entry.spans.append(span)
+            self._span_watermark = max(self._span_watermark,
+                                       len(entry.spans))
 
     def clear(self) -> None:
         with self._lock:
             self._traces.clear()
             self._dropped_traces = 0
+            self._dropped_spans_total = 0
+            self._span_watermark = 0
+            self._trace_watermark = 0
 
     # ------------------------------------------------------------- read --
 
     def trace_ids(self) -> list[str]:
         with self._lock:
             return list(self._traces)
+
+    def export_spans(self) -> list[tuple[str, list["Span"], float]]:
+        """Every retained trace as (trace_id, spans, wall_t), oldest trace
+        first — the timeline exporter's raw-span source (monotonic start/
+        end preserved; the renders above round and rebase)."""
+        with self._lock:
+            return [(tid, list(e.spans), e.wall_t or 0.0)
+                    for tid, e in self._traces.items()]
 
     def _snapshot(self, trace_id: str) -> tuple[list["Span"], int, float] | None:
         with self._lock:
@@ -122,6 +143,16 @@ class FlightRecorder:
         with self._lock:
             ids = list(self._traces)
             dropped_traces = self._dropped_traces
+            meta = {
+                "evicted_traces": self._dropped_traces,
+                "dropped_spans_total": self._dropped_spans_total,
+                "trace_watermark": self._trace_watermark,
+                "span_watermark": self._span_watermark,
+                "trace_ring_utilization": round(
+                    len(self._traces) / self.max_traces, 6),
+                "span_watermark_utilization": round(
+                    self._span_watermark / self.max_spans_per_trace, 6),
+            }
         traces = []
         for trace_id in reversed(ids):
             snap = self._snapshot(trace_id)
@@ -155,6 +186,7 @@ class FlightRecorder:
             },
             "trace_count": len(traces),
             "dropped_traces": dropped_traces,
+            "meta": meta,
             "traces": traces,
         }
 

@@ -149,7 +149,7 @@ class SLOMonitor:
                 for w, burn in zip(self.windows, burns):
                     metrics.SLO_BURN.labels(
                         replica=self.replica, objective=name, klass=klass,
-                        window=f"{w:g}").set(burn)
+                        window=f"{w:g}").set(burn)  # tpulint: disable=OBS003 -- windows is a fixed 2-element config tuple, not per-request
                 if burns and all(b >= self.burn_critical for b in burns):
                     new = CRITICAL
                 elif burns and all(b >= self.burn_warn for b in burns):
@@ -346,6 +346,25 @@ class SLOPlane:
             else:
                 table[klass] = HINTS[own]
         return table
+
+    def ledgers(self) -> dict[str, object]:
+        """Registered token ledgers by replica — the timeline exporter's
+        per-step anatomy source (obs-internal; serving never calls this)."""
+        with self._lock:
+            return {rid: e["ledger"] for rid, e in self._replicas.items()
+                    if e.get("ledger") is not None}
+
+    def controller_payload(self) -> dict | None:
+        """Render the registered controller-info provider (None when no
+        controller registered or the provider fails)."""
+        with self._lock:
+            controller_info = self._controller_info
+        if not callable(controller_info):
+            return None
+        try:
+            return controller_info() or None
+        except Exception:  # noqa: BLE001 - debug payload must render
+            return None
 
     def slo_payload(self) -> dict:
         s = get_settings()

@@ -80,6 +80,20 @@ class AsyncEngine:
         # allocator's resident/host populations, the router snapshots them
         # (serving/routing.py owns the cross-domain handoff)
         self.digest = ReplicaDigest(replica)
+        # deep observability: page-pool observatory + always-on sampled
+        # step profiler (obs/hbm.py, obs/continuous.py), federated per
+        # replica exactly like the SLO plane
+        from githubrepostorag_tpu.obs.continuous import (
+            ContinuousProfiler, register_profiler)
+        from githubrepostorag_tpu.obs.hbm import PageObservatory, get_hbm_plane
+
+        self.page_obs = PageObservatory(replica)
+        if hasattr(engine, "attach_page_observer"):
+            engine.attach_page_observer(self.page_obs)
+        self.page_obs.attach_pool_view(self._pool_view)
+        get_hbm_plane().register(replica, self.page_obs)
+        self.continuous = ContinuousProfiler(replica)
+        register_profiler(replica, self.continuous)
         # lifecycle is event-loop state: MultiAsyncEngine transitions it and
         # its _pick reads it, both on the loop; other threads only render it
         self.lifecycle = "active"
@@ -102,6 +116,29 @@ class AsyncEngine:
             replica, ledger=self.ledger, monitor=self.slo, stats=self.stats,
             digest=self.digest,
         )
+
+    def _pool_view(self) -> dict:
+        """Advisory allocator snapshot for the page observatory's payload
+        renders.  Deliberately lock-free: every read is a GIL-atomic
+        attribute load or a one-bytecode list copy, and /debug/hbm must
+        render even when the driver is wedged holding its lock."""
+        alloc = self.engine._allocator
+        free = list(getattr(alloc, "_free", ()))
+        lru = getattr(alloc, "_lru", None)
+        out = {
+            "num_pages": alloc.num_pages,
+            "free": alloc.free_count,
+            "plain_free": len(free),
+            "cached_lru": len(lru) if lru is not None else 0,
+            "host_pages": getattr(alloc, "host_pages", 0),
+            "free_pages": free,
+            "hit_tokens": getattr(alloc, "hit_tokens", 0),
+        }
+        for k in ("fault_ins", "writebacks", "dedup_hits", "host_evictions",
+                  "tier_drops", "page_imports", "import_dedup_skips",
+                  "preempt_parked_pages"):
+            out[k] = getattr(alloc, k, 0)
+        return out
 
     # ------------------------------------------------------------ lifecycle
 
@@ -294,6 +331,13 @@ class AsyncEngine:
                 m_waiting.set(self.engine.num_waiting)
                 export_counters()
                 snap = engine_snapshot(self.engine) if has_work else None
+                # queue/pool depths for the continuous profiler, read under
+                # the driver lock so a sample is internally consistent
+                q_depths = (self.engine.num_running, self.engine.num_waiting,
+                            getattr(self.engine, "num_parked", 0))
+                pool_alloc = self.engine._allocator
+                pool_depths = (pool_alloc.free_count,
+                               getattr(pool_alloc, "host_pages", 0))
                 # rate-limited chain-digest rebuild for the fleet router —
                 # allocator maps are driver-lock state, so build here and
                 # publish the frozen view through the digest's own lock
@@ -313,6 +357,11 @@ class AsyncEngine:
                 compiles = self.profiler.on_step(step_start, step_end)
                 self.ledger.on_step(snap, step_start, step_end,
                                     compiles=compiles)
+                # always-on sampled anatomy: every Nth step lands in the
+                # continuous ring (PROFILE_SAMPLE_EVERY); off the lock, so
+                # a flush can never stretch the locked section
+                self.continuous.on_step(step_end, self.ledger.last_rec or {},
+                                        queue=q_depths, pool=pool_depths)
             else:
                 self.profiler.idle()
                 self.ledger.idle()

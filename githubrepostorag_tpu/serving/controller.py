@@ -63,6 +63,7 @@ from typing import Any, Callable
 
 from githubrepostorag_tpu import metrics
 from githubrepostorag_tpu.config import get_settings
+from githubrepostorag_tpu.obs.hbm import get_hbm_plane
 from githubrepostorag_tpu.obs.slo import get_slo_plane
 from githubrepostorag_tpu.resilience.faults import fire_sync
 from githubrepostorag_tpu.resilience.policy import get_breaker
@@ -204,6 +205,10 @@ class FleetController:
             alive = ae.driver_alive()
             age = (now - hb) if started else None
             d["lifecycle"] = ae.lifecycle
+            # page-pool evidence for hbm_pages attributions: held claims,
+            # occupancy integral, host-tier depth (obs/hbm.py) — None when
+            # no observatory is registered for this replica
+            d["hbm"] = get_hbm_plane().justification(rid, now)
             d["liveness"] = {
                 "started": started,
                 "thread_alive": alive,
@@ -278,6 +283,7 @@ class FleetController:
                         "ledger": d.get("ledger"),
                         "burn": d.get("burn"),
                         "liveness": d.get("liveness"),
+                        "hbm": d.get("hbm"),
                     },
                 })
             # a decision that vanished this tick resets its hysteresis

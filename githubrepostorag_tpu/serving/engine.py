@@ -652,8 +652,40 @@ class Engine:
         # ``_pending_first`` waves, get overlaid into the next burst's
         # chained last/lens state, and commit with that burst's fetch.
         self._chain: dict | None = None
-        self._deferred: list[tuple[int, list[int]]] = []
+        # (row, pages, request_id): the rid rides along so the page
+        # observatory attributes page-seconds until the TRUE recycle time
+        # in _drain_chain, keeping its per-request integral consistent
+        # with the allocator-side occupancy integral
+        self._deferred: list[tuple[int, list[int], str]] = []
         self._pending_first: list[tuple[jnp.ndarray, list[tuple[_Request, int]]]] = []
+
+        # advisory page observatory (obs/hbm.py) — request-attribution seams
+        self._page_obs = None
+
+    # ------------------------------------------------- page observability --
+
+    def attach_page_observer(self, obs) -> None:
+        """Register a page observatory: the allocator reports claim deltas
+        and tier events, the engine reports per-request holds/releases.
+        Both directions are advisory — observability must never break
+        serving, so every call is fenced."""
+        self._page_obs = obs
+        self._allocator.attach_observer(obs)
+
+    def _obs_hold(self, req: "_Request") -> None:
+        if self._page_obs is not None:
+            try:
+                self._page_obs.on_request_hold(
+                    req.request_id, req.priority, len(req.pages))
+            except Exception:  # noqa: BLE001 - advisory seam
+                pass
+
+    def _obs_release(self, rid: str) -> None:
+        if self._page_obs is not None:
+            try:
+                self._page_obs.on_request_release(rid)
+            except Exception:  # noqa: BLE001 - advisory seam
+                pass
 
     # ------------------------------------------------------------- intake --
 
@@ -983,6 +1015,9 @@ class Engine:
         pages, req.pages = req.pages, []
         self.preempted_pages += len(pages)
         self._allocator.park(pages)
+        # park ends this hold; the resume re-admission opens a new one
+        # under the same rid (the observatory merges the two)
+        self._obs_release(req.request_id)
         row = req.row
         self._free_rows.append(row)
         self._row_req.pop(row, None)
@@ -1399,7 +1434,7 @@ class Engine:
         rows_avail = bool(self._free_rows) or bool(self._deferred)
         # only deferred pages nobody else shares actually free on drain
         extra = sum(
-            self._allocator.releasable_count(pages) for _, pages in self._deferred
+            self._allocator.releasable_count(pages) for _, pages, _ in self._deferred
         )
         return rows_avail and self._allocator.can_admit(
             hashes, need, extra_free=extra, headroom=self._class_headroom(req))
@@ -1461,6 +1496,7 @@ class Engine:
             row = self._free_rows.pop()
             req.row, req.pages, req.state = row, pages, "prefilling"
             req.prefill_start_t = time.monotonic()
+            self._obs_hold(req)
             if self._kv_tier_on:
                 req.faulted_pages += self._allocator.fault_ins - faults_before
                 claimed = hashes[len(shared):]
@@ -2632,8 +2668,9 @@ class Engine:
             waves = self._pending_first
             self._pending_first = []
             self._commit_first_tokens(waves, finished)
-        for row, pages in self._deferred:
+        for row, pages, rid in self._deferred:
             self._allocator.release(pages)
+            self._obs_release(rid)
             self._free_rows.append(row)
         self._deferred.clear()
 
@@ -2676,9 +2713,10 @@ class Engine:
             if self._chain is not None:
                 # an in-flight burst still reads this row's pages; recycle
                 # only after the chain drains
-                self._deferred.append((req.row, req.pages))
+                self._deferred.append((req.row, req.pages, req.request_id))
             else:
                 self._allocator.release(req.pages)
+                self._obs_release(req.request_id)
                 self._free_rows.append(req.row)
             self._row_req.pop(req.row, None)
             self._seq_lens[req.row] = 0

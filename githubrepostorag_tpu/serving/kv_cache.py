@@ -229,7 +229,41 @@ class OutOfPages(RuntimeError):
     responds by queueing (or preempting) instead of corrupting the cache."""
 
 
-class PageAllocator:
+class _ObserverSeam:
+    """Advisory hooks feeding the page observatory (obs/hbm.py).
+
+    A *claim* is one block-table listing backed by the pool: one refcount
+    where refcounts exist, one allocated page where they don't.  The
+    allocator reports claim deltas at the exact mutation sites, so the
+    observatory's occupancy integral is maintained by construction rather
+    than sampled.  Hooks are advisory — a raising observer must never
+    break serving, so every call is fenced.  With no observer attached
+    the cost is one falsy attribute check per allocator mutation.
+    """
+
+    _obs = None  # class default: observability off
+
+    def attach_observer(self, obs) -> None:
+        """Register an object with ``on_claims(delta)`` and
+        ``on_tier_event(kind, n)`` (duck-typed: obs/hbm.PageObservatory)."""
+        self._obs = obs
+
+    def _note_claims(self, delta: int) -> None:
+        if self._obs is not None and delta:
+            try:
+                self._obs.on_claims(delta)
+            except Exception:  # noqa: BLE001 - advisory seam
+                pass
+
+    def _note_tier_event(self, kind: str, n: int = 1) -> None:
+        if self._obs is not None and n:
+            try:
+                self._obs.on_tier_event(kind, n)
+            except Exception:  # noqa: BLE001 - advisory seam
+                pass
+
+
+class PageAllocator(_ObserverSeam):
     """Free-list allocator over the page pool."""
 
     def __init__(self, num_pages: int) -> None:
@@ -243,10 +277,13 @@ class PageAllocator:
     def allocate(self, n: int) -> list[int]:
         if n > len(self._free):
             raise OutOfPages(f"need {n} pages, {len(self._free)} free")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        self._note_claims(n)
+        return out
 
     def release(self, pages: list[int]) -> None:
         self._free.extend(pages)
+        self._note_claims(-len(pages))
 
     def can_admit(self, hashes: list[bytes], need: int, extra_free: int = 0,
                   headroom: int = 0) -> bool:
@@ -269,7 +306,7 @@ def page_hashes(prompt: list[int], page_size: int) -> list[bytes]:
     return chain_hashes(prompt, page_size)
 
 
-class PrefixCachingAllocator:
+class PrefixCachingAllocator(_ObserverSeam):
     """Refcounting page allocator with an automatic prefix cache.
 
     Every allocated page carries a refcount.  ``register`` associates a page
@@ -314,9 +351,11 @@ class PrefixCachingAllocator:
                 del self._hash_to_page[h]
             self._rc[page] = 1
             out.append(page)
+        self._note_claims(n)
         return out
 
     def release(self, pages: list[int]) -> None:
+        self._note_claims(-len(pages))
         # park TAIL-first: a chain is only matchable from its head, so the
         # head must be the last thing eviction takes (evict-leaf-first) —
         # parking in block-table order would evict h0 first and strand the
@@ -379,6 +418,7 @@ class PrefixCachingAllocator:
                 del self._lru[page]
             self._rc[page] = self._rc.get(page, 0) + 1
             out.append(page)
+        self._note_claims(len(out))
         return out
 
     def register(self, h: bytes, page: int) -> None:
@@ -522,6 +562,7 @@ class TieredPageAllocator(PrefixCachingAllocator):
                     self.tier_drops += 1
             self._rc[page] = 1
             out.append(page)
+        self._note_claims(n)
         return out
 
     def _pick_eviction(self) -> int:
@@ -586,6 +627,9 @@ class TieredPageAllocator(PrefixCachingAllocator):
         the one faulting page (paying a single migration)."""
         out: list[int] = []
         seen: set[int] = set()
+        device_bumps = 0  # host hits claim via allocate(1) below — the
+        # allocate seam counts those, so this seam counts ONLY direct
+        # refcount bumps or the observatory would double-count claims
         for h in hashes:
             page = self._hash_to_page.get(h)
             if page is not None:
@@ -597,6 +641,7 @@ class TieredPageAllocator(PrefixCachingAllocator):
                 if page in self._lru:
                     del self._lru[page]
                 self._rc[page] = self._rc.get(page, 0) + 1
+                device_bumps += 1
                 out.append(page)
                 continue
             payload = self._host.get(h)
@@ -614,8 +659,10 @@ class TieredPageAllocator(PrefixCachingAllocator):
             self._page_to_hash[page] = h
             self._staged_faults.append((page, payload))
             self.fault_ins += 1
+            self._note_tier_event("fault_in")
             seen.add(page)
             out.append(page)
+        self._note_claims(device_bumps)
         return out
 
     # --------------------------------------------------------- migration --
@@ -681,8 +728,9 @@ class TieredPageAllocator(PrefixCachingAllocator):
             if self._rc.get(page, 0) <= 1 and not (
                     h in self._host or h in self._wb_inflight):
                 self._park_queue[h] = None
-        self.release(pages)
+        self.release(pages)  # claims seam fires inside release
         self.preempt_parked_pages += len(pages)
+        self._note_tier_event("park", len(pages))
         return resumable
 
     def complete_writeback(self, h: bytes, payload: object) -> None:
@@ -693,11 +741,13 @@ class TieredPageAllocator(PrefixCachingAllocator):
         self._wb_inflight.discard(h)
         self._host[h] = payload
         self.writebacks += 1
+        self._note_tier_event("writeback")
         if self.host_pool_pages > 0:
             while len(self._host) > self.host_pool_pages:
                 cold = next(iter(self._host))
                 del self._host[cold]
                 self.host_evictions += 1
+                self._note_tier_event("host_evict")
 
     def fault_in(self) -> list[tuple[int, object]]:
         """Drain the staged host→device transitions for this step's scatter
@@ -734,11 +784,13 @@ class TieredPageAllocator(PrefixCachingAllocator):
             return False
         self._host[h] = payload
         self.page_imports += 1
+        self._note_tier_event("import")
         if self.host_pool_pages > 0:
             while len(self._host) > self.host_pool_pages:
                 cold = next(iter(self._host))
                 del self._host[cold]
                 self.host_evictions += 1
+                self._note_tier_event("host_evict")
         return True
 
     # ------------------------------------------------------ pending claims --

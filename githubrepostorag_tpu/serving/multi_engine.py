@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import time
+from collections import deque
 from typing import Any, AsyncIterator
 
 from githubrepostorag_tpu import metrics
@@ -115,6 +117,12 @@ class MultiAsyncEngine:
             AsyncEngine(e, replica=f"r{i}") for i, e in enumerate(engines)
         ]
         self._by_id = {ae.replica: ae for ae in self._engines}
+        # bounded fleet-event ring for /debug/timeline: router picks,
+        # lifecycle transitions, fences (with victim request ids), disagg
+        # handoffs.  Appends are GIL-atomic deque ops on the event loop;
+        # the timeline exporter snapshots from any thread.  Created before
+        # the spare-marking loop below — _set_lifecycle records into it.
+        self._timeline_events: deque[dict] = deque(maxlen=512)
         self._route: dict[str, AsyncEngine] = {}
         # in-flight lifecycle operation per replica: a second drain() or
         # activate() awaits the running task instead of racing it (the
@@ -162,6 +170,16 @@ class MultiAsyncEngine:
         from githubrepostorag_tpu.obs.slo import get_slo_plane
 
         get_slo_plane().set_router_info(self.router_stats)
+        # the timeline exporter reads the fleet-event ring through the same
+        # provider inversion as set_router_info above
+        from githubrepostorag_tpu.obs.timeline import set_fleet_events_provider
+
+        set_fleet_events_provider(lambda: list(self._timeline_events))
+
+    def _tl(self, kind: str, **attrs: Any) -> None:
+        ev = {"t": time.monotonic(), "kind": kind}
+        ev.update(attrs)
+        self._timeline_events.append(ev)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -178,6 +196,7 @@ class MultiAsyncEngine:
         ae.lifecycle = state
         metrics.FLEET_LIFECYCLE.labels(replica=ae.replica).set(
             _LIFECYCLE_GAUGE[state])
+        self._tl("fleet.lifecycle", replica=ae.replica, state=state)
 
     def _in_flight(self, ae: AsyncEngine) -> int:
         return (ae.engine.num_running + ae.engine.num_waiting
@@ -290,6 +309,10 @@ class MultiAsyncEngine:
             self._route.pop(rid, None)
         self._breakers[replica].record_failure()
         _span().add_event("fleet.fence", replica=replica, failed=len(failed))
+        # the victim rids ride the event (capped) so the timeline can mark
+        # each fenced request on the dead replica's own track
+        self._tl("fleet.fence", replica=replica, failed=len(failed),
+                 failed_requests=failed[:32])
         return {"replica": replica, "lifecycle": ae.lifecycle,
                 "failed": len(failed)}
 
@@ -421,6 +444,10 @@ class MultiAsyncEngine:
             resident_pages=res, host_pages=hst,
             breaker_granted=granted,
         )
+        self._tl("router.pick", replica=target.replica,
+                 decision=decision or self._policy or "least_loaded",
+                 resident_pages=res, host_pages=hst,
+                 breaker_granted=granted)
         return target, granted
 
     def _load(self, ae: AsyncEngine) -> float:
@@ -676,6 +703,8 @@ class MultiAsyncEngine:
         _span().add_event("disagg.handoff", prefill=pre.replica,
                           decode=dest.replica, shipped=stored,
                           deduped=deduped)
+        self._tl("disagg.handoff", prefill=pre.replica,
+                 decode=dest.replica, shipped=stored, deduped=deduped)
 
         yielded = False
         parked = False
@@ -747,13 +776,16 @@ class MultiAsyncEngine:
             self._count("skipped_breaker_open")
         self._routed[target.replica] += 1
         metrics.ROUTER_ROUTED.labels(replica=target.replica).inc()
+        self._tl("router.pick_decode", replica=target.replica,
+                 breaker_granted=granted)
         return target, granted
 
     def _handoff_fallback(self, reason: str) -> None:
         self._handoff_fallbacks[reason] = (
             self._handoff_fallbacks.get(reason, 0) + 1)
-        metrics.DISAGG_HANDOFFS.labels(outcome=f"fallback_{reason}").inc()
+        metrics.DISAGG_HANDOFFS.labels(outcome=f"fallback_{reason}").inc()  # tpulint: disable=OBS003 -- reason is the closed set of handoff fallback causes
         _span().add_event("disagg.fallback", reason=reason)
+        self._tl("disagg.fallback", reason=reason)
 
     def disagg_stats(self) -> dict[str, Any]:
         """Handoff economics + role census (router_stats and /debug/fleet

@@ -71,6 +71,8 @@ class OpenAIServer:
         app.router.add_get("/debug/slo", self.debug_slo)
         app.router.add_get("/debug/fleet", self.debug_fleet)
         app.router.add_get("/debug/index", self.debug_index)
+        app.router.add_get("/debug/hbm", self.debug_hbm)
+        app.router.add_get("/debug/timeline", self.debug_timeline)
         app.router.add_post("/debug/fleet/drain", self.fleet_drain)
         app.router.add_post("/debug/fleet/activate", self.fleet_activate)
         return app
@@ -113,6 +115,23 @@ class OpenAIServer:
         from githubrepostorag_tpu.retrieval.live_index import live_index_payload
 
         return web.json_response(live_index_payload())
+
+    async def debug_hbm(self, request: web.Request) -> web.Response:
+        from githubrepostorag_tpu.obs.hbm import get_hbm_plane
+
+        return web.json_response(get_hbm_plane().payload())
+
+    async def debug_timeline(self, request: web.Request) -> web.Response:
+        """One Perfetto trace for the recent past (?window_s= bounds it);
+        save the body and open it in ui.perfetto.dev."""
+        from githubrepostorag_tpu.obs.timeline import build_timeline
+
+        try:
+            window_s = float(request.query["window_s"]) \
+                if "window_s" in request.query else None
+        except ValueError:
+            return _error_response("window_s must be a number", status=400)
+        return web.json_response(build_timeline(window_s=window_s))
 
     async def _fleet_lifecycle(self, request: web.Request, verb: str) -> web.Response:
         """Shared body for POST /debug/fleet/{drain,activate}: duck-typed on

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Bench-history regression gate: compare fresh bench artifacts against the
+committed per-scenario baselines.
+
+Each bench run drops ``artifacts/BENCH_<scenario>_cpu.json``; the repo root
+carries the committed history (``BENCH_<scenario>_cpu.json``).  This script
+joins the two record lists on the ``metric`` name, infers the improvement
+direction from the unit (throughput up is good, latency down is good), and
+flags any metric that moved against its direction by more than the noise
+threshold.
+
+CPU-tiny scenarios are noisy (shared CI hosts, thermal jitter), so the gate
+is deliberately warn-by-default: regressions print and the exit stays 0
+unless ``BENCH_STRICT=1`` (or ``--strict``) is set.  The threshold is
+relative (default 30%) with a small absolute floor so near-zero baselines
+don't produce infinite ratios.
+
+    python scripts/bench_compare.py artifacts/BENCH_*_cpu.json
+    BENCH_STRICT=1 python scripts/bench_compare.py artifacts/BENCH_kv_tier_cpu.json
+    python scripts/bench_compare.py --threshold 0.5 artifacts/BENCH_disagg_cpu.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+# improvement direction by unit; units missing here are informational only
+HIGHER_IS_BETTER = {"tok/s", "q/s", "docs/s", "x", "ratio", "%"}
+LOWER_IS_BETTER = {"ms", "s"}
+
+ABS_FLOOR = 1e-9  # baselines below this are treated as "no signal"
+
+
+def load_records(path: Path) -> dict[str, dict]:
+    data = json.loads(path.read_text())
+    out: dict[str, dict] = {}
+    for rec in data.get("records", []):
+        name = rec.get("metric")
+        if isinstance(name, str) and isinstance(rec.get("value"), (int, float)):
+            out[name] = rec
+    return out
+
+
+def compare_file(fresh_path: Path, baseline_path: Path, threshold: float):
+    """Yield (severity, message) for one fresh/baseline artifact pair.
+
+    severity: 'regression' | 'improved' | 'info'
+    """
+    fresh = load_records(fresh_path)
+    base = load_records(baseline_path)
+    missing = sorted(set(base) - set(fresh))
+    new = sorted(set(fresh) - set(base))
+    for name in missing:
+        yield ("info", f"{fresh_path.name}: metric '{name}' present in the "
+               "committed baseline but absent from this run")
+    for name in new:
+        yield ("info", f"{fresh_path.name}: new metric '{name}' has no "
+               "committed baseline yet")
+    for name in sorted(set(fresh) & set(base)):
+        unit = base[name].get("unit")
+        b, f = float(base[name]["value"]), float(fresh[name]["value"])
+        if abs(b) < ABS_FLOOR:
+            continue
+        delta = (f - b) / abs(b)
+        if unit in HIGHER_IS_BETTER:
+            regressed, improved = delta < -threshold, delta > threshold
+        elif unit in LOWER_IS_BETTER:
+            regressed, improved = delta > threshold, delta < -threshold
+        else:
+            continue
+        pct = f"{delta:+.1%}"
+        line = (f"{fresh_path.name}: {name} = {f:g} {unit} "
+                f"vs baseline {b:g} ({pct}, threshold {threshold:.0%})")
+        if regressed:
+            yield ("regression", line)
+        elif improved:
+            yield ("improved", line)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", nargs="+", type=Path,
+                    help="fresh bench JSON artifacts (artifacts/BENCH_*.json)")
+    ap.add_argument("--baseline-dir", type=Path, default=REPO,
+                    help="directory holding the committed BENCH_*.json")
+    ap.add_argument("--threshold", type=float, default=0.30,
+                    help="relative move that counts as a regression (0.30 = 30%%)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on regressions (BENCH_STRICT=1 does the same)")
+    args = ap.parse_args(argv)
+    strict = args.strict or os.environ.get("BENCH_STRICT") == "1"
+
+    regressions = improvements = 0
+    compared = 0
+    for fresh_path in args.fresh:
+        if not fresh_path.exists():
+            print(f"bench-compare: skipping missing {fresh_path}")
+            continue
+        baseline_path = args.baseline_dir / fresh_path.name
+        if not baseline_path.exists():
+            print(f"bench-compare: no committed baseline for "
+                  f"{fresh_path.name}; commit the artifact to start history")
+            continue
+        compared += 1
+        for severity, line in compare_file(fresh_path, baseline_path,
+                                           args.threshold):
+            if severity == "regression":
+                regressions += 1
+                print(f"REGRESSION  {line}")
+            elif severity == "improved":
+                improvements += 1
+                print(f"improved    {line}")
+            else:
+                print(f"note        {line}")
+
+    print(f"bench-compare: {compared} artifact(s), {regressions} "
+          f"regression(s), {improvements} improvement(s) beyond "
+          f"{args.threshold:.0%} "
+          f"[{'strict' if strict else 'warn-only; BENCH_STRICT=1 to gate'}]")
+    if regressions and strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

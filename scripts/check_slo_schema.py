@@ -134,6 +134,10 @@ def build_payloads():
                 "liveness": {"started": True, "thread_alive": False,
                              "heartbeat_age_s": 6.2, "driver_error": None,
                              "breaker": "closed"},
+                # page-pool evidence (obs/hbm.PageObservatory.justification)
+                "hbm": {"held_pages": 12, "held_peak": 20,
+                        "occupancy_page_s": 42.5, "live_requests": 2,
+                        "plain_free": 18, "host_pages": 4},
             },
             "detail": {"victim": "r0", "spare": "r2", "no_spare": False,
                        "trigger": "dead"},

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""CI gate: the /debug/timeline and /debug/hbm JSON shapes must match the
+committed golden.
+
+Perfetto loads whatever it's given, so a field rename in the trace-event
+stream fails silently — tracks just vanish from the UI.  This script
+populates every timeline source (flight-recorder spans, ledger steps,
+continuous-profiler samples, page-observatory events and attribution,
+controller log, fleet events, FAULTS injections) deterministically
+through the real obs APIs, renders both payloads with the same functions
+the API handlers call (``build_timeline`` / ``_HBMPlane.payload``),
+reduces them to type shapes, and diffs against
+``tests/golden/debug_timeline_schema.json``.
+
+The trace-event list is shaped per event kind (one representative shape
+for each ph/category pair) — a plain first-element reduction would only
+ever check the process_name metadata record.
+
+    python scripts/check_timeline_schema.py            # verify (CI)
+    python scripts/check_timeline_schema.py --write    # intentional change
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+GOLDEN = REPO / "tests" / "golden" / "debug_timeline_schema.json"
+
+
+def shape(value):
+    """Recursive type-shape: dict keys are part of the schema, values
+    reduce to type names, lists reduce to the first element's shape."""
+    if isinstance(value, dict):
+        return {k: shape(v) for k, v in sorted(value.items())}
+    if isinstance(value, list):
+        return [shape(value[0])] if value else []
+    if isinstance(value, bool):
+        return "bool"
+    if isinstance(value, (int, float)):
+        return "number"
+    if value is None:
+        return "null"
+    return type(value).__name__
+
+
+def event_key(ev: dict) -> str:
+    """Stable kind label for one trace event: metadata by record name,
+    counters by series (replica prefix stripped), slices/instants by
+    phase+category."""
+    ph = ev.get("ph")
+    if ph == "M":
+        return f"M:{ev['name']}"
+    if ph == "C":
+        return "C:" + ev["name"].split(" ", 1)[-1]
+    return f"{ph}:{ev.get('cat', '')}"
+
+
+def event_shapes(trace: dict) -> dict:
+    by_kind: dict[str, object] = {}
+    for ev in trace["traceEvents"]:
+        by_kind.setdefault(event_key(ev), shape(ev))
+    return dict(sorted(by_kind.items()))
+
+
+def build_payloads():
+    """Populate every source the exporter merges, with one synthetic
+    replica and explicit timestamps, then render both debug payloads."""
+    # a deterministic injection BEFORE the first get_registry() call: the
+    # fired event lands in the registry's timeline ring
+    os.environ["FAULTS"] = "fleet.step.r0:error"
+    from githubrepostorag_tpu.config import reload_settings
+    reload_settings()
+
+    from githubrepostorag_tpu.obs.continuous import (ContinuousProfiler,
+                                                     register_profiler)
+    from githubrepostorag_tpu.obs.hbm import PageObservatory, get_hbm_plane
+    from githubrepostorag_tpu.obs.ledger import SNAPSHOT_FIELDS, TokenLedger
+    from githubrepostorag_tpu.obs.recorder import get_recorder, reset_recorder
+    from githubrepostorag_tpu.obs.slo import SLOMonitor, get_slo_plane, reset_slo_plane
+    from githubrepostorag_tpu.obs.timeline import (build_timeline,
+                                                   set_fleet_events_provider)
+    from githubrepostorag_tpu.obs.trace import Span, TraceContext
+    from githubrepostorag_tpu.resilience.faults import get_registry
+
+    now = time.monotonic()
+    reset_recorder()
+    reset_slo_plane()
+
+    # ---- flight-recorder span tree: root + nested child + span event ----
+    ctx = TraceContext("ab" * 16, None, 1)
+    root = Span("api.request", ctx, start=now - 2.0)
+    root.set_attr("path", "/rag/jobs")
+    root.add_event("router.pick", replica="r0", decision="affinity_hit")
+    child = Span("engine.decode", root.context, start=now - 1.8)
+    child.finish(end=now - 1.2)
+    root.finish(end=now - 1.0)
+    assert get_recorder().trace_ids()
+
+    # ---- token ledger steps (per-replica anatomy tracks) ----
+    ledger = TokenLedger("r0", flops_per_tok=1e9, peak_flops=1e12,
+                         window_s=60.0)
+    snap = {f: 0.0 for f in SNAPSHOT_FIELDS}
+    ledger.on_step(dict(snap), now - 1.0, now - 0.8, compiles=1)
+    snap.update(committed_tokens=8, prefill_tokens=16,
+                prefill_seconds_total=0.1, decode_seconds_total=0.1)
+    ledger.on_step(dict(snap), now - 0.7, now - 0.2)
+    get_slo_plane().register("r0", ledger=ledger, monitor=SLOMonitor("r0"),
+                             stats=lambda: {"role": "fused"})
+
+    # ---- continuous profiler samples ----
+    prof = ContinuousProfiler("r0", sample_every=1, ring=8)
+    prof.on_step(now - 0.6, {"prefill": 0.01, "decode": 0.05, "wall": 0.06},
+                 queue=(2, 1, 0), pool=(30, 2))
+    register_profiler("r0", prof)
+
+    # ---- page observatory: claims, holds, tier events ----
+    obs = PageObservatory("r0")
+    obs.attach_pool_view(lambda: {
+        "num_pages": 64, "free": 40, "plain_free": 30, "cached_lru": 10,
+        "host_pages": 2, "free_pages": [1, 2, 3, 8, 9], "hit_tokens": 64,
+        "fault_ins": 1, "writebacks": 1, "dedup_hits": 1,
+        "host_evictions": 0, "tier_drops": 0, "page_imports": 1,
+        "import_dedup_skips": 0, "preempt_parked_pages": 4,
+    })
+    obs.on_claims(8, now=now - 1.5)
+    obs.on_request_hold("req-a", "interactive", 8, now=now - 1.5)
+    obs.on_tier_event("writeback", 2, now=now - 1.1)
+    obs.on_tier_event("fault_in", 1, now=now - 0.9)
+    obs.on_claims(-8, now=now - 0.5)
+    obs.on_request_release("req-a", now=now - 0.5)
+    obs.on_claims(4, now=now - 0.4)
+    obs.on_request_hold("req-b", "batch", 4, now=now - 0.4)
+    get_hbm_plane().register("r0", obs)
+
+    # ---- controller action log (same render the slo golden pins) ----
+    get_slo_plane().set_controller_info(lambda: {
+        "log": [{
+            "t": now - 0.4, "replica": "r0", "action": "failover",
+            "reason": "dead", "status": "dispatched",
+            "justification": {"ledger": ledger.justification(now),
+                              "burn": None, "liveness": None,
+                              "hbm": obs.justification(now)},
+            "detail": {"victim": "r0", "spare": "r2"},
+        }],
+    })
+
+    # ---- fleet events: every kind multi_engine records ----
+    set_fleet_events_provider(lambda: [
+        {"t": now - 1.9, "kind": "fleet.lifecycle", "replica": "r0",
+         "state": "active"},
+        {"t": now - 1.6, "kind": "router.pick", "replica": "r0",
+         "decision": "affinity_hit", "resident_pages": 3, "host_pages": 1,
+         "breaker_granted": True},
+        {"t": now - 1.3, "kind": "router.pick_decode", "replica": "r0",
+         "breaker_granted": True},
+        {"t": now - 0.9, "kind": "disagg.handoff", "prefill": "r0",
+         "decode": "r1", "shipped": 4, "deduped": 2},
+        {"t": now - 0.8, "kind": "disagg.fallback", "reason": "preempted"},
+        {"t": now - 0.3, "kind": "fleet.fence", "replica": "r0",
+         "failed": 1, "failed_requests": ["req-b"]},
+    ])
+
+    # ---- FAULTS injection instant (the spec set above fires here) ----
+    action, _ = get_registry().decide("fleet.step.r0")
+    assert action == "error", "synthetic FAULTS spec did not fire"
+
+    # span events and the fault ring stamp real monotonic time, which is
+    # later than the base `now` the backdated fixtures hang off — render
+    # against a timestamp taken after everything has been recorded
+    render_now = time.monotonic()
+    timeline = build_timeline(window_s=60.0, now=render_now)
+    hbm = get_hbm_plane().payload(render_now)
+    return timeline, hbm
+
+
+def main() -> int:
+    timeline, hbm = build_payloads()
+    missing = [k for k, v in timeline["metadata"]["sources"].items() if not v]
+    if missing:
+        print(f"synthetic build produced no events for: {missing}",
+              file=sys.stderr)
+        return 1
+    top = dict(timeline)
+    top["traceEvents"] = []  # shaped per kind below, not first-element
+    current = {
+        "GET /debug/timeline": shape(top),
+        "GET /debug/timeline traceEvents": event_shapes(timeline),
+        "GET /debug/hbm": shape(hbm),
+    }
+    if "--write" in sys.argv:
+        GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+        GOLDEN.write_text(json.dumps(current, indent=2, sort_keys=True) + "\n")
+        print(f"wrote {GOLDEN.relative_to(REPO)}")
+        return 0
+    if not GOLDEN.exists():
+        print(f"missing golden {GOLDEN.relative_to(REPO)}; run with --write",
+              file=sys.stderr)
+        return 1
+    golden = json.loads(GOLDEN.read_text())
+    if golden != current:
+        print("/debug/timeline schema drifted from the committed golden.",
+              file=sys.stderr)
+        print("golden:  " + json.dumps(golden, sort_keys=True), file=sys.stderr)
+        print("current: " + json.dumps(current, sort_keys=True), file=sys.stderr)
+        print("If intentional: python scripts/check_timeline_schema.py --write",
+              file=sys.stderr)
+        return 1
+    print("debug/timeline schema matches golden")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,6 +37,9 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_traces_schema.py
 echo "== /debug/slo + /debug/fleet schema =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_slo_schema.py
 
+echo "== /debug/timeline + /debug/hbm schema =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/check_timeline_schema.py
+
 echo "== kv-tier oversubscription A/B (CPU-tiny) =="
 # tiered vs device-only pool at equal HBM budget: bench_kv_tier_pair
 # asserts >=1.5x admitted concurrency, token-identical outputs, and zero
@@ -108,6 +111,12 @@ echo "== self-healing fleet-controller A/B (CPU-tiny) =="
 # no-controller arm collapses below the same bar with requests hung to
 # timeout against the corpse.
 BENCH_ONLY=controller JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py
+
+echo "== bench history vs committed baselines =="
+# noise-tolerant comparison of this run's artifacts against the committed
+# BENCH_*_cpu.json history: warn-by-default (CPU-tiny numbers jitter on
+# shared hosts); export BENCH_STRICT=1 to turn regressions into failures
+python scripts/bench_compare.py artifacts/BENCH_*_cpu.json
 
 echo "== tier-1 tests =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m 'not slow' \

@@ -49,10 +49,18 @@ def _fresh_state():
     """Reset process-wide singletons (bus hub, store, settings) per test."""
     from githubrepostorag_tpu.config import reload_settings
     from githubrepostorag_tpu.events.memory import reset_memory_hub
+    from githubrepostorag_tpu.obs.continuous import reset_profilers
+    from githubrepostorag_tpu.obs.hbm import reset_hbm_plane
     from githubrepostorag_tpu.obs.slo import reset_slo_plane
+    from githubrepostorag_tpu.obs.timeline import reset_fleet_events_provider
     from githubrepostorag_tpu.resilience.faults import reset_faults
     from githubrepostorag_tpu.resilience.policy import reset_breakers
     from githubrepostorag_tpu.store.factory import reset_store
+
+    def _reset_obs():
+        reset_profilers()
+        reset_hbm_plane()
+        reset_fleet_events_provider()
 
     reload_settings()
     reset_memory_hub()
@@ -60,9 +68,11 @@ def _fresh_state():
     reset_faults()
     reset_breakers()
     reset_slo_plane()
+    _reset_obs()
     yield
     reset_memory_hub()
     reset_store()
     reset_faults()
     reset_breakers()
     reset_slo_plane()
+    _reset_obs()

@@ -1,0 +1,21 @@
+"""OBS003 negative: label values drawn from small closed vocabularies."""
+from prometheus_client import Counter, Gauge
+
+CALLS = Counter("rag_calls_total", "calls", ["replica", "status"])
+DEPTH = Gauge("rag_depth", "queue depth", ["replica", "priority"])
+
+
+def handle(replica, ok):
+    CALLS.labels(replica=replica, status="ok" if ok else "error").inc()
+
+
+def publish(replica, priority, n, status_code):
+    DEPTH.labels(replica=replica, priority=priority).set(n)
+    # str() of a bounded enum-ish value is fine; only id-like args fire
+    CALLS.labels(replica=replica, status=str(status_code)).inc()
+
+
+def not_a_metric(rows, request_id):
+    # .labels() on a dataframe-ish object with a non-metric meaning: the
+    # keyword is what fires, and 'axis' isn't an id token
+    return rows.labels(axis=0)

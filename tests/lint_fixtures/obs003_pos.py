@@ -1,0 +1,20 @@
+"""OBS003 positive: per-request identifiers used as metric label values."""
+import uuid
+
+from prometheus_client import Counter
+
+REQUESTS = Counter("rag_requests_total", "requests", ["request_id"])
+LATENCY = Counter("rag_latency_total", "latency", ["route"])
+
+
+def handle(request_id, job):
+    REQUESTS.labels(request_id=request_id).inc()  # id keyword + id value
+    LATENCY.labels(route=f"/jobs/{job.job_id}").inc()  # f-string label
+
+
+def tag_by_attribute(metric, req):
+    metric.labels(req.trace_id).inc()  # positional attribute id
+
+
+def tag_by_generator(metric):
+    metric.labels(client=str(uuid.uuid4())).inc()  # str(uuid4())

@@ -39,7 +39,7 @@ WPA_FIXTURES = FIXTURES / "wpa"
 SHP_FIXTURES = FIXTURES / "shp"
 SPD_FIXTURES = FIXTURES / "spd"
 RULE_IDS = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-            "TPU007", "ASY001", "ASY002", "OBS001", "OBS002"]
+            "TPU007", "ASY001", "ASY002", "OBS001", "OBS002", "OBS003"]
 WPA_RULE_IDS = ["WPA001", "WPA002", "WPA003", "WPA004"]
 SHP_RULE_IDS = ["SHP001", "SHP002", "SHP003", "SHP004"]
 SPD_RULE_IDS = ["SPD001", "SPD002", "SPD003", "SPD004", "SPD005"]
@@ -86,6 +86,15 @@ def test_obs002_suppressed_fixture_is_silenced_with_justification():
     # sanctioned in-function construction; it rides on a justified disable
     findings = analyze_file(FIXTURES / "obs002_sup.py")
     hits = [f for f in findings if f.rule == "OBS002"]
+    assert hits, "suppressed variant should still produce (suppressed) findings"
+    assert all(f.suppressed and f.justification for f in hits)
+
+
+def test_obs003_suppressed_fixture_is_silenced_with_justification():
+    # a genuinely bounded "id-shaped" label set (fixed tenant roster) is the
+    # sanctioned exception; it rides on a justified disable
+    findings = analyze_file(FIXTURES / "obs003_sup.py")
+    hits = [f for f in findings if f.rule == "OBS003"]
     assert hits, "suppressed variant should still produce (suppressed) findings"
     assert all(f.suppressed and f.justification for f in hits)
 

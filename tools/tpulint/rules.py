@@ -981,3 +981,79 @@ def check_obs002(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
                     "registry/cardinality leak; build it at module scope "
                     "and use .labels() here",
                 )
+
+
+# --------------------------------------------------------------------------
+# OBS003 — unbounded-cardinality metric label value
+
+
+_OBS003_ID_TOKENS = frozenset({
+    "request_id", "trace_id", "span_id", "job_id", "session_id", "task_id",
+    "correlation_id", "user_id", "uuid", "guid", "rid",
+})
+
+_OBS003_ID_CALLS = frozenset({"uuid1", "uuid4", "token_hex", "token_urlsafe"})
+
+
+@register(
+    "OBS003",
+    "unbounded-cardinality metric label",
+    "Every distinct label value materializes a new timeseries that lives for "
+    "the process lifetime: labeling by request/trace/job id or an f-string "
+    "interpolation leaks one series per request, bloats every scrape, and "
+    "eventually OOMs the registry. Keep label values to small closed sets "
+    "(replica, route template, outcome) and put unbounded ids in structured "
+    "logs or span attributes instead.",
+)
+def check_obs003(ctx: FileContext) -> Iterator[tuple[int, int, str]]:
+    def id_like(node: ast.AST) -> str | None:
+        """Expression that smells like a per-request identifier: a name or
+        attribute whose terminal component is an id token, a uuid/token
+        generator call, or str() of either."""
+        if isinstance(node, ast.Name) and node.id.lower() in _OBS003_ID_TOKENS:
+            return node.id
+        if isinstance(node, ast.Attribute) and node.attr.lower() in _OBS003_ID_TOKENS:
+            return node.attr
+        if isinstance(node, ast.Call):
+            fn = dotted(node.func) or ""
+            base = fn.rsplit(".", 1)[-1]
+            if base in _OBS003_ID_CALLS:
+                return f"{base}()"
+            if base == "str" and len(node.args) == 1:
+                inner = id_like(node.args[0])
+                if inner is not None:
+                    return f"str({inner})"
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "labels"
+        ):
+            continue
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg.lower() in _OBS003_ID_TOKENS:
+                yield (
+                    node.lineno, node.col_offset,
+                    f"metric label '{kw.arg}' is a per-request id — one "
+                    "timeseries per value for the life of the process; "
+                    "label by a bounded set and log the id instead",
+                )
+        for value in [*node.args, *(kw.value for kw in node.keywords)]:
+            if isinstance(value, ast.JoinedStr):
+                yield (
+                    value.lineno, value.col_offset,
+                    ".labels() value is an f-string — interpolated label "
+                    "values are unbounded cardinality; use a closed "
+                    "vocabulary and log the dynamic part instead",
+                )
+                continue
+            source = id_like(value)
+            if source is not None:
+                yield (
+                    value.lineno, value.col_offset,
+                    f".labels() value '{source}' is a per-request id — one "
+                    "timeseries per value for the life of the process; "
+                    "label by a bounded set and log the id instead",
+                )
